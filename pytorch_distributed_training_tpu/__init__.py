@@ -1,0 +1,32 @@
+"""pytorch_distributed_training_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework with the capabilities of
+``qqaatw/pytorch-distributed-training`` (mounted read-only at /root/reference):
+data-parallel and hybrid data×model-parallel BERT fine-tuning on GLUE, with
+gradient accumulation, mixed precision (bf16 on TPU instead of fp16 AMP),
+distributed eval-metric aggregation, deterministic seeding, checkpointing,
+Pallas fused-attention kernels, and ring-attention sequence parallelism.
+
+This is an idiomatic TPU-first design, not a port: the reference's DDP
+wrappers, ``.to(device)`` shuttling and ``no_sync()`` flags dissolve into
+mesh sharding (GSPMD) + ``jax.jit`` + XLA collectives over ICI/DCN.
+
+Layout
+------
+- ``comms``     — process bootstrap, device mesh, collectives, host→mesh ingest
+                  (replaces torch.distributed / NCCL / Gloo; SURVEY.md §2b)
+- ``models``    — in-repo BERT/RoBERTa/GPT-2 in flax.linen + composite models
+                  (branch-ensemble "TriBert" and 2-stage pipeline "ConcatBert"
+                  equivalents; reference test_model_parallelism.py:40-163)
+- ``ops``       — attention implementations incl. Pallas flash attention and
+                  ring attention for sequence/context parallelism
+- ``parallel``  — sharding policies (dp / fsdp / tensor / stage axes),
+                  gradient accumulation
+- ``train``     — optimizer, schedules, TrainState, jitted train/eval steps,
+                  metrics, checkpointing
+- ``data``      — GLUE pipelines with fixed-length padding, per-host sharding,
+                  synthetic offline fallback
+- ``utils``     — configs, logging, profiling
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+from pytorch_distributed_training_tpu.comms.bootstrap import (
+    RuntimeInfo,
+    initialize,
+    runtime_info,
+)
+from pytorch_distributed_training_tpu.comms.mesh import (
+    batch_pspec,
+    build_mesh,
+    replicated,
+)
+from pytorch_distributed_training_tpu.comms.collectives import (
+    gather_pytree,
+    host_allgather,
+)
+from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+
+__all__ = [
+    "RuntimeInfo",
+    "initialize",
+    "runtime_info",
+    "build_mesh",
+    "batch_pspec",
+    "replicated",
+    "gather_pytree",
+    "host_allgather",
+    "make_global_batch",
+]

@@ -1,0 +1,87 @@
+"""Collective helpers — in-jit wrappers and host-side (cross-process) gathers.
+
+Replaces, TPU-natively, the reference's collective layer (SURVEY.md §2b):
+
+- DDP's implicit gradient allreduce (reference test_data_parallelism.py:146,
+  test_model_parallelism.py:296) → nothing to call: with the batch sharded
+  over the mesh and params replicated, XLA inserts the AllReduce. The
+  explicit ``psum*`` helpers below exist for shard_map code (ring attention,
+  pipeline) that manages its own collectives.
+- ``accelerator.gather`` / hand-copied ``gather()`` for eval metrics
+  (test_data_parallelism.py:160-161; test_model_parallelism.py:24-37) →
+  ``gather_pytree`` / ``host_allgather``. The reference's copy is broken for
+  anything but a plain tensor (it calls ``_gpu_gather``/``honor_type`` that
+  don't exist, SURVEY.md §2c-2); ours is pytree-aware by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+# --------------------------------------------------------------------------
+# In-jit collectives (require a mapped axis: inside shard_map / vmap+axis).
+# --------------------------------------------------------------------------
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, *, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute_shift(x, axis_name, shift: int = 1):
+    """Circular shift along a mesh axis (ring building block)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+# --------------------------------------------------------------------------
+# Host-side cross-process gathers (outside jit).
+# --------------------------------------------------------------------------
+
+def host_allgather(x: np.ndarray | jnp.ndarray) -> np.ndarray:
+    """All-gather a per-process array across hosts, concatenating on dim 0.
+
+    Semantics of the reference's ``gather`` (test_model_parallelism.py:24-37):
+    scalars are promoted to 1-element arrays (:33-34) and results concatenate
+    along dim 0. Single-process: identity (after promotion).
+    """
+    arr = np.asarray(x)
+    if arr.ndim == 0:
+        arr = arr[None]
+    if jax.process_count() == 1:
+        return arr
+    # process_allgather stacks a new leading axis; flatten it into dim 0 to
+    # match torch.distributed.all_gather + cat(dim=0).
+    gathered = multihost_utils.process_allgather(arr)
+    return np.reshape(gathered, (-1,) + arr.shape[1:])
+
+
+def gather_pytree(tree):
+    """Pytree-aware cross-process gather (fixes SURVEY.md §2c-2)."""
+    return jax.tree.map(host_allgather, tree)
+
+
+def broadcast_from_host0(tree):
+    """Make process-0's value authoritative everywhere (config/seed sync)."""
+    if jax.process_count() == 1:
+        return tree
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def assert_same_across_hosts(tree, name: str = "value") -> None:
+    """Guard against divergent per-host values (which deadlock collectives —
+    the 'consistent global batches' hazard, SURVEY.md §7 hard parts)."""
+    if jax.process_count() == 1:
+        return
+    multihost_utils.assert_equal(tree, fail_message=f"{name} differs across hosts")

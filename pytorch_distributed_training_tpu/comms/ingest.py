@@ -1,0 +1,34 @@
+"""Host→mesh data ingest: per-host numpy shards → one global sharded array.
+
+The reference shards data at the Python index level — ``DistributedSampler``
+(reference test_model_parallelism.py:254,262) or ``accelerator.prepare`` of
+the DataLoaders (test_data_parallelism.py:125-127) — and each process copies
+its own batch H2D every step (:142). The TPU-native equivalent: each host
+holds only its slice of the global batch and
+``jax.make_array_from_process_local_data`` assembles the logical global array
+directly onto the mesh, sharded over the batch axes. No host ever
+materializes the full global batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from pytorch_distributed_training_tpu.comms.mesh import batch_pspec
+
+
+def make_global_batch(mesh: Mesh, local_batch):
+    """Assemble a global, batch-sharded array pytree from per-host shards.
+
+    ``local_batch`` leaves are numpy arrays whose dim 0 is this host's slice
+    of the global batch (global = local * process_count). Works unchanged in
+    single-process runs (local == global).
+    """
+    def _make(x: np.ndarray):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, batch_pspec(extra_dims=x.ndim - 1))
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(_make, local_batch)

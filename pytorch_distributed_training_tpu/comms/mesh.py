@@ -1,0 +1,100 @@
+"""Device-mesh construction and sharding-spec helpers.
+
+The mesh is the framework's single abstraction for ALL parallelism — the
+TPU-native replacement for the reference's per-strategy machinery (DDP
+process groups for data parallelism, hand-placed ``.to(device)`` calls for
+model parallelism; reference test_model_parallelism.py:98-103,190-191).
+Canonical axes ``(data, fsdp, stage, model)`` — see
+``utils.config.MeshConfig``. The batch shards over ``(data, fsdp)``;
+parameters shard over ``fsdp`` (ZeRO-style), ``stage`` (pipeline) and
+``model`` (tensor/branch) as the sharding policy dictates. XLA then inserts
+the actual ICI/DCN collectives (psum for gradients = DDP's NCCL allreduce,
+collective-permute for stage transfer = the reference's ``.to(device)``
+activation shuttling).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_training_tpu.utils.config import MeshConfig
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_STAGE = "stage"
+AXIS_MODEL = "model"
+AXIS_NAMES = MeshConfig.AXIS_NAMES
+
+# Batch dimension shards over both flavors of data parallelism.
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 4-axis logical mesh over the given (default: all) devices.
+
+    ``mesh_utils.create_device_mesh`` lays physical devices out so that the
+    fastest-varying logical axes map to physically adjacent chips — i.e. the
+    ``model``/``stage`` axes (which carry per-step activation/weight
+    collectives) ride ICI, while ``data`` (one gradient psum per step) can
+    span DCN. This is the mesh-axis→interconnect mapping that replaces the
+    reference's NCCL-vs-Gloo backend choice (SURVEY.md §5).
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    shape = config.resolved_shape(len(devices))
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except (ValueError, AssertionError, NotImplementedError):
+        # create_device_mesh can reject exotic topologies (or the axon
+        # single-chip tunnel); a plain reshape is always valid, just not
+        # locality-optimized.
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_NAMES)
+
+
+def batch_pspec(extra_dims: int = 0) -> P:
+    """PartitionSpec for a batch-leading array: shard dim 0 over data+fsdp.
+
+    This single spec IS the framework's data parallelism: with the batch
+    sharded and parameters replicated (or fsdp-sharded), jit emits the
+    gradient AllReduce over ICI that DDP did through NCCL (reference
+    test_data_parallelism.py:146; SURVEY.md §2b).
+    """
+    return P(BATCH_AXES, *([None] * extra_dims))
+
+
+def replicated() -> P:
+    return P()
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device-put a host-global batch pytree with batch-axis sharding."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, batch_pspec())), batch
+    )
+
+
+def axis_size(mesh: Mesh, *axes: str) -> int:
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
+def dp_degree(mesh: Mesh) -> int:
+    """Total data-parallel degree (number of batch shards)."""
+    return axis_size(mesh, *BATCH_AXES)

@@ -1,0 +1,3 @@
+from pytorch_distributed_training_tpu.utils.logging import get_logger, log0
+
+__all__ = ["get_logger", "log0"]

@@ -1,0 +1,235 @@
+"""Typed configuration + real CLI parsing.
+
+Replaces the reference's hardcoded config dict
+``{"lr": 2e-5, "num_epochs": 3, "correct_bias": True, "seed": 42,
+"batch_size": 96}`` (reference test_data_parallelism.py:174) and its magic
+constants ``MAX_GPU_BATCH_SIZE = 8`` / ``EVAL_BATCH_SIZE = 32``
+(test_data_parallelism.py:49-50). Defaults here match the reference exactly
+so convergence/throughput comparisons are apples-to-apples.
+
+Also fixes the reference's ``argparse type=bool`` bug (any non-empty string,
+including ``--fp16=False``, parsed truthy; test_data_parallelism.py:171-172)
+by using ``argparse.BooleanOptionalAction``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical device-mesh shape.
+
+    Canonical axis order is ``(data, fsdp, stage, model)``:
+
+    - ``data``  — pure data parallelism (per-replica batch shard; gradients
+      psum over this axis, the XLA/ICI equivalent of DDP's NCCL allreduce,
+      reference test_data_parallelism.py:146).
+    - ``fsdp``  — data parallelism with parameters/optimizer state sharded on
+      their leading dim (ZeRO-3 style, as a sharding rule, not a new engine).
+    - ``stage`` — pipeline stages (the ConcatBert 2-stage layer split,
+      reference test_model_parallelism.py:40-89, generalized).
+    - ``model`` — tensor/branch model parallelism (the TriBert branch axis,
+      reference test_model_parallelism.py:92-163, and sharded matmuls).
+
+    Any axis set to ``-1`` absorbs all remaining devices (at most one).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    stage: int = 1
+    model: int = 1
+
+    AXIS_NAMES = ("data", "fsdp", "stage", "model")
+
+    def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int]:
+        sizes = [self.data, self.fsdp, self.stage, self.model]
+        n_fill = sum(1 for s in sizes if s == -1)
+        if n_fill > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
+        fixed = 1
+        for s in sizes:
+            if s != -1:
+                if s < 1:
+                    raise ValueError(f"invalid mesh axis size {s}")
+                fixed *= s
+        if n_fill:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes = [n_devices // fixed if s == -1 else s for s in sizes]
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh shape {sizes} (={fixed} devices) != available devices {n_devices}"
+            )
+        return tuple(sizes)  # type: ignore[return-value]
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Transformer encoder/decoder hyperparameters.
+
+    Presets cover the reference's models: ``bert-base-cased`` (hidden 768, 12
+    layers; reference test_model_parallelism.py:230-238), ``bert-large-cased``
+    (hidden 1024, 24 layers; test_data_parallelism.py:112), plus
+    ``roberta-large`` and ``gpt2-medium`` for the driver's extra configs
+    (BASELINE.json configs[3-4]).
+    """
+
+    vocab_size: int = 28996  # bert-*-cased vocab
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    attention_impl: str = "reference"  # "reference" | "flash" | "ring"
+    # dtype policy: params fp32, compute bf16 (TPU-native replacement for the
+    # reference's fp16 AMP, test_data_parallelism.py:55; SURVEY.md §2b).
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # causal decoder flag (GPT-2 family)
+    causal: bool = False
+    # RoBERTa-style embeddings (pad-offset position ids, no token types)
+    roberta_style: bool = False
+    pad_token_id: int = 0
+    remat: bool = False  # jax.checkpoint each layer (trade FLOPs for HBM)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+_MODEL_PRESETS: dict[str, dict[str, Any]] = {
+    # reference test_data_parallelism.py:69 uses bert-large-cased tokenizer
+    # (vocab 28996) and :112 the bert-large-cased model.
+    "bert-base-cased": dict(
+        vocab_size=28996, hidden_size=768, num_layers=12, num_heads=12,
+        intermediate_size=3072,
+    ),
+    "bert-large-cased": dict(
+        vocab_size=28996, hidden_size=1024, num_layers=24, num_heads=16,
+        intermediate_size=4096,
+    ),
+    "roberta-large": dict(
+        vocab_size=50265, hidden_size=1024, num_layers=24, num_heads=16,
+        intermediate_size=4096, max_position_embeddings=514,
+        type_vocab_size=1, roberta_style=True, pad_token_id=1,
+        layer_norm_eps=1e-5,
+    ),
+    "gpt2-medium": dict(
+        vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16,
+        intermediate_size=4096, max_position_embeddings=1024,
+        type_vocab_size=0, causal=True, layer_norm_eps=1e-5,
+    ),
+    # tiny config for tests (no reference counterpart; SURVEY.md §4 parity tests)
+    "tiny": dict(
+        vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=128,
+    ),
+}
+
+
+def model_preset(name: str, **overrides: Any) -> ModelConfig:
+    if name not in _MODEL_PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(_MODEL_PRESETS)}")
+    kwargs = dict(_MODEL_PRESETS[name])
+    kwargs.update(overrides)
+    return ModelConfig(**kwargs)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Training hyperparameters; defaults mirror the reference.
+
+    - lr 2e-5, 3 epochs, seed 42, global batch 96 → micro batch 8 ×
+      accumulation 12 (reference test_data_parallelism.py:49-50,89-93,174)
+    - eval batch 32 (test_data_parallelism.py:50)
+    - AdamW **with** bias correction (``correct_bias=True``,
+      test_data_parallelism.py:120,174)
+    - linear schedule with 100 warmup steps (test_data_parallelism.py:131-135)
+    - bf16 replaces the fp16 AMP flag (test_data_parallelism.py:55)
+
+    The accumulation boundary here is the *correct* one — update after every
+    ``grad_accum_steps`` microbatches — not the reference's off-by-one
+    ``step % accum == 0`` that steps on the very first microbatch
+    (SURVEY.md §2c-1).
+    """
+
+    learning_rate: float = 2e-5
+    num_epochs: int = 3
+    seed: int = 42
+    global_batch_size: int = 96
+    micro_batch_size: int = 8  # reference MAX_GPU_BATCH_SIZE
+    eval_batch_size: int = 32
+    warmup_steps: int = 100
+    weight_decay: float = 0.0
+    max_grad_norm: float = 1.0
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+    bf16: bool = True
+    max_seq_length: int = 128  # the reference's own TPU pad branch (:96-98)
+    log_every: int = 50
+    checkpoint_dir: str | None = None
+    checkpoint_every_steps: int = 0  # 0 = per-epoch only
+    resume: bool = False
+    profile_dir: str | None = None  # enable jax.profiler traces when set
+    debug_nans: bool = False
+
+    @property
+    def grad_accum_steps(self) -> int:
+        """Derived exactly as the reference derives it (:89-93): if the
+        requested global batch exceeds the micro batch, split."""
+        if self.global_batch_size % self.micro_batch_size:
+            raise ValueError(
+                f"global_batch_size {self.global_batch_size} must be divisible "
+                f"by micro_batch_size {self.micro_batch_size}"
+            )
+        return self.global_batch_size // self.micro_batch_size
+
+
+def add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") -> None:
+    """Register every field of a dataclass as a typed CLI flag.
+
+    Booleans become ``--flag/--no-flag`` pairs (BooleanOptionalAction),
+    fixing the reference's ``type=bool`` bug (SURVEY.md §2c-4).
+    """
+    for f in dataclasses.fields(cls):
+        if f.name.isupper():
+            continue
+        name = f"--{prefix}{f.name.replace('_', '-')}"
+        default = f.default if f.default is not dataclasses.MISSING else None
+        ftype = f.type if isinstance(f.type, type) else str(f.type)
+        if ftype in (bool, "bool"):
+            parser.add_argument(
+                name, action=argparse.BooleanOptionalAction, default=default
+            )
+        elif ftype in (int, "int"):
+            parser.add_argument(name, type=int, default=default)
+        elif ftype in (float, "float"):
+            parser.add_argument(name, type=float, default=default)
+        else:
+            parser.add_argument(name, type=str, default=default)
+
+
+def dataclass_from_args(cls, args: argparse.Namespace, prefix: str = ""):
+    # argparse converts dashes in flag names to underscores in dests; mirror
+    # that here so e.g. prefix="mesh-" finds dest "mesh_data".
+    dest_prefix = prefix.replace("-", "_")
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name.isupper():
+            continue
+        key = f"{dest_prefix}{f.name}"
+        if hasattr(args, key):
+            kwargs[f.name] = getattr(args, key)
+    return cls(**kwargs)

@@ -1,0 +1,42 @@
+"""Process-0-gated structured logging.
+
+The reference's observability is bare ``print`` gated on the main process
+(``accelerator.print``, reference test_data_parallelism.py:165-166;
+``if rank == 0``, test_model_parallelism.py:314-315). Here: ``get_logger``
+returns an ordinary (ungated) ``logging`` logger; ``log0`` is the
+process-0-gated emission helper that call sites should use for anything that
+would otherwise print once per host.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in practice
+        return 0
+
+
+def get_logger(name: str = "pdt_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def log0(msg: str, *args, logger: logging.Logger | None = None) -> None:
+    """Log on process 0 only (the reference's rank-0 print pattern)."""
+    if _process_index() == 0:
+        (logger or get_logger()).info(msg, *args)

@@ -1,0 +1,55 @@
+"""Test harness configuration.
+
+The reference repo has no test suite at all (SURVEY.md §4) — its only
+verification is end-to-end convergence. This framework instead follows the
+standard JAX simulated-distributed strategy: run every test single-process on
+8 virtual CPU devices (``--xla_force_host_platform_device_count=8``) so mesh /
+pjit / psum code paths execute real SPMD partitioning with no TPU attached.
+
+This module MUST run before anything imports jax, which pytest guarantees for
+a root conftest. The axon TPU plugin (this image's tunnel to one real chip) is
+explicitly disabled for tests — benchmarks use it, tests don't.
+"""
+
+import os
+
+# Disable the axon single-TPU tunnel for tests; force an 8-device CPU mesh.
+# The axon sitecustomize registers its PJRT plugin at interpreter startup
+# (before any conftest can run), so clearing env vars is not enough — we also
+# flip the already-imported jax to CPU and reset its backend cache.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Private API, required to un-register the axon backend that sitecustomize
+# already installed. Guarded so a future jax rename fails with a clear message.
+try:
+    import jax._src.xla_bridge as _xb  # noqa: E402
+
+    _xb._clear_backends()
+except (ImportError, AttributeError) as e:  # pragma: no cover
+    raise RuntimeError(
+        "jax private API _clear_backends moved (jax upgrade?); update conftest"
+    ) from e
+if len(jax.devices()) != 8:  # pragma: no cover - depends on launch env
+    raise RuntimeError(
+        f"conftest failed to set up the 8-device CPU mesh (got {jax.devices()})"
+    )
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+    return devices
