@@ -28,7 +28,13 @@ def make_global_batch(mesh: Mesh, local_batch):
     """
     def _make(x: np.ndarray):
         x = np.asarray(x)
-        sharding = NamedSharding(mesh, batch_pspec(extra_dims=x.ndim - 1))
+        if x.ndim == 0:
+            raise ValueError(
+                "make_global_batch leaves must have a leading batch dim; "
+                "got a 0-d scalar (promote it with x[None] first)"
+            )
+        # A PartitionSpec shorter than the array rank replicates trailing dims.
+        sharding = NamedSharding(mesh, batch_pspec())
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(_make, local_batch)

@@ -1,0 +1,6 @@
+from pytorch_distributed_training_tpu.models.bert import (
+    BertEncoderModel,
+    BertForSequenceClassification,
+)
+
+__all__ = ["BertEncoderModel", "BertForSequenceClassification"]

@@ -1,0 +1,218 @@
+"""In-repo BERT encoder family (flax.linen), TPU-first.
+
+The reference always rides HuggingFace's torch BERT
+(``AutoModelForSequenceClassification("bert-large-cased")``, reference
+test_data_parallelism.py:112; three ``bert-base-cased`` instances,
+test_model_parallelism.py:230-238). This framework owns the model: a pure
+functional flax implementation whose parameter layout is deliberately
+HF-mappable (see ``models.hf_loader``) so pretrained checkpoints load when a
+hub cache is available, while everything else — dtype policy, attention
+implementation, remat, sharding — is native to this framework.
+
+TPU design notes:
+- bf16 compute / fp32 params policy (the fp16-AMP replacement, SURVEY.md §2b):
+  every Dense/Embed takes ``dtype=compute_dtype, param_dtype=param_dtype``;
+  softmax and LayerNorm statistics stay fp32.
+- Q/K/V/O projections are ``DenseGeneral`` straight to/from
+  [heads, head_dim] — one reshape-free matmul each, MXU-friendly.
+- ``config.remat`` wraps each layer in ``jax.checkpoint`` to trade FLOPs for
+  HBM on long sequences / big batches.
+- RoBERTa is the same trunk with pad-offset learned positions and no token
+  types (``config.roberta_style``); GPT-2 reuses the attention stack with
+  ``causal=True`` (see ``models.gpt2``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_bias,
+)
+from pytorch_distributed_training_tpu.utils.config import ModelConfig
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.compute_dtype)
+
+
+def _pdtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.param_dtype)
+
+
+class BertEmbeddings(nn.Module):
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, position_ids, deterministic):
+        cfg = self.config
+        kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg))
+        embed_init = nn.initializers.normal(stddev=0.02)
+        words = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, embedding_init=embed_init,
+            name="word_embeddings", **kw,
+        )(input_ids)
+        positions = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            embedding_init=embed_init, name="position_embeddings", **kw,
+        )(position_ids)
+        x = words + positions
+        if cfg.type_vocab_size:
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_size, embedding_init=embed_init,
+                name="token_type_embeddings", **kw,
+            )(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=_pdtype(cfg), name="norm")(x)
+        x = x.astype(_dtype(cfg))
+        return nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+
+class BertSelfAttention(nn.Module):
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, deterministic):
+        cfg = self.config
+        kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
+                  kernel_init=nn.initializers.normal(stddev=0.02))
+        heads_shape = (cfg.num_heads, cfg.head_dim)
+        q = nn.DenseGeneral(heads_shape, axis=-1, name="query", **kw)(x)
+        k = nn.DenseGeneral(heads_shape, axis=-1, name="key", **kw)(x)
+        v = nn.DenseGeneral(heads_shape, axis=-1, name="value", **kw)(x)
+        dropout_rng = None
+        if not deterministic and cfg.attention_dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, attention_bias,
+            impl=cfg.attention_impl,
+            dropout_rng=dropout_rng,
+            dropout_rate=cfg.attention_dropout,
+            deterministic=deterministic,
+            causal=cfg.causal,
+        )
+        return nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), name="out", **kw
+        )(out)
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer block (BERT convention)."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, deterministic):
+        cfg = self.config
+        kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
+                  kernel_init=nn.initializers.normal(stddev=0.02))
+        ln = dict(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                  param_dtype=_pdtype(cfg))
+
+        attn_out = BertSelfAttention(cfg, name="attention")(
+            x, attention_bias, deterministic
+        )
+        attn_out = nn.Dropout(cfg.hidden_dropout)(
+            attn_out, deterministic=deterministic
+        )
+        x = nn.LayerNorm(**ln, name="attention_norm")(x + attn_out)
+        x = x.astype(_dtype(cfg))
+
+        h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        x = nn.LayerNorm(**ln, name="mlp_norm")(x + h)
+        return x.astype(_dtype(cfg))
+
+
+class BertEncoderModel(nn.Module):
+    """Embeddings + N layers + pooler → (sequence_output, pooled_output)."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        position_ids=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            if cfg.roberta_style:
+                # RoBERTa: positions count non-pad tokens, offset past pad id.
+                mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+                position_ids = jnp.cumsum(mask, axis=-1) * mask + cfg.pad_token_id
+            else:
+                position_ids = jnp.broadcast_to(
+                    jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
+                )
+
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        bias = make_attention_bias(attention_mask)
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, bias, deterministic)
+
+        cls = x[:, 0]
+        if cfg.roberta_style:
+            # RobertaClassificationHead applies dropout BEFORE its dense
+            # (dropout → dense → tanh → dropout → out_proj); BERT's pooler
+            # does not. Keep the distinction so fine-tuning regularizes
+            # identically to the respective HF heads.
+            cls = nn.Dropout(cfg.hidden_dropout)(cls, deterministic=deterministic)
+        pooled = nn.Dense(
+            cfg.hidden_size, dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
+            kernel_init=nn.initializers.normal(stddev=0.02), name="pooler",
+        )(cls)
+        pooled = jnp.tanh(pooled)
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    """Trunk + dropout + classifier head → logits [batch, num_labels].
+
+    Loss lives in the train step (functional style), not the module — unlike
+    the reference where CE loss is computed inside ``forward``
+    (test_model_parallelism.py:153-156).
+    """
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        position_ids=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        _, pooled = BertEncoderModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids,
+            deterministic,
+        )
+        pooled = nn.Dropout(cfg.hidden_dropout)(
+            pooled, deterministic=deterministic
+        )
+        logits = nn.Dense(
+            cfg.num_labels, dtype=jnp.float32, param_dtype=_pdtype(cfg),
+            kernel_init=nn.initializers.normal(stddev=0.02), name="classifier",
+        )(pooled.astype(jnp.float32))
+        return logits

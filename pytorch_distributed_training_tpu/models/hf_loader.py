@@ -1,0 +1,138 @@
+"""HuggingFace checkpoint → framework pytree weight loader.
+
+The reference always starts from HF pretrained weights
+(``from_pretrained("bert-large-cased")``, reference
+test_data_parallelism.py:112; test_model_parallelism.py:230-238). This module
+maps a torch BERT/RoBERTa ``state_dict`` (or an in-memory ``transformers``
+model, or a local checkpoint directory) onto this framework's flax parameter
+pytree. Torch ``nn.Linear`` stores weights [out, in]; flax kernels are
+[in, out] — every dense weight transposes, and Q/K/V/O reshape to/from the
+[heads, head_dim] DenseGeneral layout (SURVEY.md §7 hard parts: "transpose
+conventions for dense kernels").
+
+Network-free by design: nothing here downloads. In this zero-egress image the
+loader is exercised against randomly-initialized ``transformers`` models
+built from configs (see tests/test_models.py), which also serves as the
+numerical parity check of the whole model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.utils.config import ModelConfig
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def state_dict_from(source: Any) -> dict[str, np.ndarray]:
+    """Accept a transformers model, a torch state_dict, a mapping of numpy
+    arrays, or a local directory containing ``model.safetensors`` /
+    ``pytorch_model.bin``."""
+    if isinstance(source, (str,)):
+        import os
+
+        st_path = os.path.join(source, "model.safetensors")
+        pt_path = os.path.join(source, "pytorch_model.bin")
+        if os.path.exists(st_path):
+            from safetensors.numpy import load_file
+
+            return dict(load_file(st_path))
+        if os.path.exists(pt_path):
+            import torch
+
+            return {
+                k: _np(v)
+                for k, v in torch.load(pt_path, map_location="cpu").items()
+            }
+        raise FileNotFoundError(f"no checkpoint found under {source!r}")
+    if hasattr(source, "state_dict"):
+        source = source.state_dict()
+    if isinstance(source, Mapping):
+        return {k: _np(v) for k, v in source.items()}
+    raise TypeError(f"unsupported checkpoint source {type(source)!r}")
+
+
+def load_bert_classifier(source: Any, config: ModelConfig) -> dict:
+    """Build the flax params pytree for ``BertForSequenceClassification``
+    from an HF BERT/RoBERTa sequence-classification checkpoint."""
+    sd = state_dict_from(source)
+    n, d, h = config.num_heads, config.head_dim, config.hidden_size
+
+    # HF prefixes: bert.* (BertForSequenceClassification) or roberta.*
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else (
+        "roberta." if any(k.startswith("roberta.") for k in sd) else ""
+    )
+
+    def W(key):  # torch Linear weight -> flax kernel
+        return _np(sd[key]).T
+
+    def arr(key):
+        return _np(sd[key])
+
+    def dense(key):
+        return {"kernel": W(key + ".weight"), "bias": arr(key + ".bias")}
+
+    def norm(key):
+        return {"scale": arr(key + ".weight"), "bias": arr(key + ".bias")}
+
+    def qkv(key):  # [out,in] -> [in, heads, head_dim]
+        return {
+            "kernel": W(key + ".weight").reshape(h, n, d),
+            "bias": arr(key + ".bias").reshape(n, d),
+        }
+
+    emb = prefix + "embeddings."
+    embeddings = {
+        "word_embeddings": {"embedding": arr(emb + "word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": arr(emb + "position_embeddings.weight")
+        },
+        "norm": norm(emb + "LayerNorm"),
+    }
+    if config.type_vocab_size:
+        embeddings["token_type_embeddings"] = {
+            "embedding": arr(emb + "token_type_embeddings.weight")
+        }
+
+    trunk: dict[str, Any] = {"embeddings": embeddings}
+    for i in range(config.num_layers):
+        lp = f"{prefix}encoder.layer.{i}."
+        trunk[f"layer_{i}"] = {
+            "attention": {
+                "query": qkv(lp + "attention.self.query"),
+                "key": qkv(lp + "attention.self.key"),
+                "value": qkv(lp + "attention.self.value"),
+                "out": {
+                    # [out,in] -> [heads, head_dim, out]
+                    "kernel": W(lp + "attention.output.dense.weight").reshape(
+                        n, d, h
+                    ),
+                    "bias": arr(lp + "attention.output.dense.bias"),
+                },
+            },
+            "attention_norm": norm(lp + "attention.output.LayerNorm"),
+            "mlp_up": dense(lp + "intermediate.dense"),
+            "mlp_down": dense(lp + "output.dense"),
+            "mlp_norm": norm(lp + "output.LayerNorm"),
+        }
+
+    if prefix + "pooler.dense.weight" in sd:
+        trunk["pooler"] = dense(prefix + "pooler.dense")
+    elif "classifier.dense.weight" in sd:
+        # RoBERTa classification heads carry their own dense; map it to the
+        # pooler slot (tanh pooling matches RobertaClassificationHead).
+        trunk["pooler"] = dense("classifier.dense")
+
+    params: dict[str, Any] = {"bert": trunk}
+    if "classifier.weight" in sd:
+        params["classifier"] = dense("classifier")
+    elif "classifier.out_proj.weight" in sd:
+        params["classifier"] = dense("classifier.out_proj")
+    return params

@@ -1,0 +1,6 @@
+from pytorch_distributed_training_tpu.ops.attention import (
+    ATTENTION_IMPLS,
+    dot_product_attention,
+)
+
+__all__ = ["ATTENTION_IMPLS", "dot_product_attention"]

@@ -1,0 +1,127 @@
+"""Attention implementations behind one swappable interface.
+
+The reference has no attention code of its own — it rides HF BERT's
+(reference test_data_parallelism.py:112). Here attention is a first-class,
+swappable op (SURVEY.md §5 long-context: "keep attention swappable (Pallas
+flash-attention kernel slot) so CP can be added later without core changes"):
+
+- ``"reference"`` — plain XLA einsum attention. Scores/softmax accumulate in
+  fp32 even under the bf16 policy (TPU MXU accumulates fp32 natively; this
+  is the numerically-safe default).
+- ``"flash"``     — Pallas (Mosaic) fused attention kernel, registered by
+  ``ops.flash_attention``.
+- ``"ring"``      — ring attention over a sequence-parallel mesh axis,
+  registered by ``ops.ring_attention``.
+
+All implementations share the signature
+``impl(q, k, v, bias, *, dropout_rng, dropout_rate, deterministic, causal)``
+with q/k/v shaped [batch, seq, heads, head_dim] and an additive fp32 bias
+broadcastable to [batch, heads, q_len, kv_len].
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+ATTENTION_IMPLS: dict[str, Callable] = {}
+
+
+def register_attention(name: str):
+    def deco(fn):
+        ATTENTION_IMPLS[name] = fn
+        return fn
+
+    return deco
+
+
+def make_attention_bias(
+    attention_mask: Optional[jnp.ndarray],
+    *,
+    dtype=jnp.float32,
+) -> Optional[jnp.ndarray]:
+    """[batch, kv_len] 1/0 mask → additive bias [batch, 1, 1, kv_len]."""
+    if attention_mask is None:
+        return None
+    neg = jnp.finfo(dtype).min
+    bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+    return bias.astype(dtype)
+
+
+def causal_bias(q_len: int, kv_len: int, dtype=jnp.float32) -> jnp.ndarray:
+    i = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+    neg = jnp.finfo(dtype).min
+    return jnp.where(j <= i, 0.0, neg).astype(dtype)[None, None, :, :]
+
+
+@register_attention("reference")
+def reference_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    causal: bool = False,
+):
+    """Plain einsum attention; softmax in fp32 regardless of input dtype."""
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5
+    # [B, S, N, D] x [B, T, N, D] -> [B, N, S, T], accumulated in fp32
+    scores = jnp.einsum(
+        "bsnd,btnd->bnst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if causal:
+        scores = scores + causal_bias(q.shape[-3], k.shape[-3])
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def dot_product_attention(
+    q,
+    k,
+    v,
+    bias=None,
+    *,
+    impl: str = "reference",
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    causal: bool = False,
+):
+    """Dispatch to the configured attention implementation."""
+    if impl not in ATTENTION_IMPLS:
+        # Lazily import optional kernels so plain use never pays the cost.
+        try:
+            if impl == "flash":
+                from pytorch_distributed_training_tpu.ops import flash_attention  # noqa: F401
+            elif impl == "ring":
+                from pytorch_distributed_training_tpu.ops import ring_attention  # noqa: F401
+        except ImportError:
+            pass  # fall through to the informative KeyError below
+    fn = ATTENTION_IMPLS.get(impl)
+    if fn is None:
+        raise KeyError(
+            f"unknown attention impl {impl!r}; registered: {sorted(ATTENTION_IMPLS)}"
+        )
+    return fn(
+        q,
+        k,
+        v,
+        bias,
+        dropout_rng=dropout_rng,
+        dropout_rate=dropout_rate,
+        deterministic=deterministic,
+        causal=causal,
+    )
