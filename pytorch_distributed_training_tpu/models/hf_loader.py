@@ -26,8 +26,13 @@ from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
 def _np(t) -> np.ndarray:
-    if hasattr(t, "detach"):
-        t = t.detach().cpu().numpy()
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().cpu()
+        if t.is_floating_point():
+            # bf16 has no numpy equivalent; fp16 would silently violate the
+            # fp32-param policy. Promote all float weights before conversion.
+            t = t.float()
+        t = t.numpy()
     return np.asarray(t)
 
 
@@ -135,4 +140,13 @@ def load_bert_classifier(source: Any, config: ModelConfig) -> dict:
         params["classifier"] = dense("classifier")
     elif "classifier.out_proj.weight" in sd:
         params["classifier"] = dense("classifier.out_proj")
-    return params
+
+    # Enforce the parameter-dtype policy (fp32 by default) on every float
+    # leaf, whatever precision the checkpoint was saved in.
+    pdtype = np.dtype(config.param_dtype)
+    import jax
+
+    return jax.tree.map(
+        lambda x: x.astype(pdtype) if np.issubdtype(x.dtype, np.floating) else x,
+        params,
+    )

@@ -108,8 +108,11 @@ def dot_product_attention(
                 from pytorch_distributed_training_tpu.ops import flash_attention  # noqa: F401
             elif impl == "ring":
                 from pytorch_distributed_training_tpu.ops import ring_attention  # noqa: F401
-        except ImportError:
-            pass  # fall through to the informative KeyError below
+        except ModuleNotFoundError as e:
+            # Only swallow "the optional module itself is absent"; a broken
+            # transitive import inside it must surface as the real error.
+            if e.name is None or not e.name.endswith((impl + "_attention",)):
+                raise
     fn = ATTENTION_IMPLS.get(impl)
     if fn is None:
         raise KeyError(

@@ -1,0 +1,7 @@
+from pytorch_distributed_training_tpu.parallel.sharding import (
+    ShardingPolicy,
+    state_shardings,
+    param_pspecs,
+)
+
+__all__ = ["ShardingPolicy", "state_shardings", "param_pspecs"]

@@ -1,0 +1,138 @@
+"""Parameter-sharding policies: DP / FSDP / tensor-parallel as PartitionSpecs.
+
+Every parallelism strategy in this framework is a *sharding policy* — a map
+from parameter-tree paths to PartitionSpecs over the canonical mesh axes —
+not a separate engine. This is the design stance SURVEY.md §2d prescribes:
+the reference's strategies (DDP replication; hand-placed model parallelism,
+test_model_parallelism.py:98-103; hybrid DDP-over-multi-device-module,
+:248-253) plus the driver's FSDP config all collapse into:
+
+- **dp**: params replicated; batch sharded over ``data`` (pure DDP twin).
+- **fsdp**: params/optimizer state additionally sharded over the ``fsdp``
+  axis on one eligible dimension (ZeRO-3 as a spec, XLA does the
+  all-gather/reduce-scatter).
+- **tp**: Megatron-style tensor parallelism over ``model`` for the
+  transformer blocks — QKV projections column-parallel on the heads dim,
+  attention out row-parallel, MLP up column- / down row-parallel. XLA
+  inserts the psum where a row-parallel matmul needs it.
+
+Optimizer state (Adam moments) shards exactly like its parameter —
+``state_shardings`` maps the policy over the whole TrainState.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_training_tpu.train.state import TrainState
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPolicy:
+    tp: bool = False  # shard transformer blocks over the "model" axis
+    fsdp: bool = False  # shard remaining/bigger dims over the "fsdp" axis
+    # minimum leaf size (elements) before fsdp sharding kicks in; tiny
+    # params (norms, biases) stay replicated — sharding them costs more in
+    # collective latency than it saves in HBM.
+    fsdp_min_size: int = 2**16
+
+
+def _tp_spec(path: tuple[str, ...], ndim: int) -> P | None:
+    """Megatron TP specs keyed on this framework's BERT parameter layout
+    (models/bert.py). Returns None when TP doesn't apply to the leaf."""
+    names = set(path)
+    leaf = path[-1]
+    if "attention" in names:
+        # query/key/value: kernel [hidden, heads, head_dim], bias [heads, hd]
+        if any(n in names for n in ("query", "key", "value")):
+            if leaf == "kernel" and ndim == 3:
+                return P(None, "model", None)
+            if leaf == "bias" and ndim == 2:
+                return P("model", None)
+        if "out" in names:
+            # out: kernel [heads, head_dim, hidden] — row-parallel (psum after)
+            if leaf == "kernel" and ndim == 3:
+                return P("model", None, None)
+            if leaf == "bias":
+                return P(None)
+    if "mlp_up" in names:
+        if leaf == "kernel" and ndim == 2:
+            return P(None, "model")
+        if leaf == "bias" and ndim == 1:
+            return P("model")
+    if "mlp_down" in names:
+        if leaf == "kernel" and ndim == 2:
+            return P("model", None)
+        if leaf == "bias":
+            return P(None)
+    return None
+
+
+def _add_fsdp(spec: P | None, shape: tuple[int, ...], fsdp_size: int,
+              min_size: int) -> P | None:
+    """Shard the largest still-unsharded divisible dim over ``fsdp``."""
+    import numpy as np
+
+    if fsdp_size <= 1 or int(np.prod(shape)) < min_size:
+        return spec
+    entries = list(spec) if spec is not None else [None] * len(shape)
+    while len(entries) < len(shape):
+        entries.append(None)
+    candidates = [
+        (shape[i], i)
+        for i in range(len(shape))
+        if entries[i] is None and shape[i] % fsdp_size == 0 and shape[i] > 1
+    ]
+    if not candidates:
+        return spec
+    _, dim = max(candidates)
+    entries[dim] = "fsdp"
+    return P(*entries)
+
+
+def _leaf_spec(path, leaf, policy: ShardingPolicy, mesh: Mesh) -> P:
+    """The single source of truth mapping one array (by path + shape) to its
+    PartitionSpec. Used for params AND optimizer moments (whose paths carry
+    the param path as a suffix), so both always shard identically."""
+    if getattr(leaf, "ndim", 0) == 0:
+        return P()
+    names = tuple(
+        p.key if hasattr(p, "key") else getattr(p, "name", str(p)) for p in path
+    )
+    spec = None
+    if policy.tp and mesh.shape["model"] > 1:
+        spec = _tp_spec(names, leaf.ndim)
+    if policy.fsdp:
+        spec = _add_fsdp(spec, leaf.shape, mesh.shape["fsdp"], policy.fsdp_min_size)
+    return spec if spec is not None else P()
+
+
+def param_pspecs(params, policy: ShardingPolicy, mesh: Mesh):
+    """PartitionSpec pytree for a parameter pytree under the given policy."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _leaf_spec(path, leaf, policy, mesh), params
+    )
+
+
+def state_shardings(state: TrainState, policy: ShardingPolicy, mesh: Mesh):
+    """NamedSharding pytree for the full TrainState.
+
+    One path-based rule applied uniformly to every array in the state:
+    Adam moments live at paths like ``opt_state[1].mu.bert.layer_0...kernel``
+    — the parameter path is a suffix — so the same TP/FSDP matcher that
+    shards a kernel shards its moments identically, and scalars (step,
+    schedule count, RNG key) fall through to replicated.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _leaf_spec(path, leaf, policy, mesh)),
+        state,
+    )
+
+
+def shard_state(state: TrainState, shardings: TrainState) -> TrainState:
+    """device_put the state onto its shardings (initial placement)."""
+    return jax.tree.map(jax.device_put, state, shardings)

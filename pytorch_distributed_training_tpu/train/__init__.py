@@ -1,0 +1,17 @@
+from pytorch_distributed_training_tpu.train.optim import (
+    adamw_with_schedule,
+    linear_warmup_schedule,
+)
+from pytorch_distributed_training_tpu.train.state import TrainState, create_train_state
+from pytorch_distributed_training_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_training_tpu.train.metrics import MetricAccumulator
+
+__all__ = [
+    "adamw_with_schedule",
+    "linear_warmup_schedule",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "MetricAccumulator",
+]

@@ -1,0 +1,41 @@
+"""In-repo eval metrics: GLUE accuracy + F1 from confusion counts.
+
+The reference computes metrics with HF ``load_metric("glue", "mrpc")``
+(reference test_data_parallelism.py:71,159-164), gathering full prediction
+tensors across ranks first (``accelerator.gather`` :160-161; hand-rolled
+allgather, test_model_parallelism.py:302-310). Network-free and
+gather-free here: the eval step reduces each batch to five masked counts
+(correct/total/tp/fp/fn) on device; hosts only ever fold scalars. Identical
+results to sklearn/HF definitions — accuracy = correct/total, binary F1 =
+2tp / (2tp + fp + fn) — verified in tests against sklearn-style closed forms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MetricAccumulator:
+    """Folds per-batch count dicts; computes accuracy (+ F1 when binary)."""
+
+    FIELDS = ("correct", "total", "tp", "fp", "fn")
+
+    def __init__(self, num_labels: int = 2):
+        self.num_labels = num_labels
+        self.reset()
+
+    def reset(self) -> None:
+        self._c = {k: 0.0 for k in self.FIELDS}
+
+    def update(self, counts: dict) -> None:
+        for k in self.FIELDS:
+            if k in counts:
+                self._c[k] += float(np.asarray(counts[k]))
+
+    def compute(self) -> dict:
+        total = self._c["total"]
+        out = {"accuracy": self._c["correct"] / total if total else 0.0}
+        if self.num_labels == 2:
+            denom = 2 * self._c["tp"] + self._c["fp"] + self._c["fn"]
+            out["f1"] = 2 * self._c["tp"] / denom if denom else 0.0
+        return out

@@ -1,0 +1,62 @@
+"""Optimizer + LR schedule, matching the reference's training recipe.
+
+The reference uses transformers ``AdamW(lr=2e-5, correct_bias=True)``
+(reference test_data_parallelism.py:120,174) — i.e. Adam *with* bias
+correction plus decoupled weight decay — and
+``get_linear_schedule_with_warmup(num_warmup_steps=100, num_training_steps=
+len(train_dataloader) * num_epochs)`` (test_data_parallelism.py:131-135).
+``optax.adamw`` implements exactly the bias-corrected update, so the recipe
+maps 1:1. Bias-correction equivalence is unit-tested against the closed-form
+update (tests/test_train.py), per SURVEY.md §4.
+
+Note the reference computes ``num_training_steps`` from the *post-prepare,
+per-process* dataloader length (SURVEY.md §2 row 6); here total steps are
+counted in optimizer updates (global-batch boundaries), the correct
+denominator under any data-parallel degree.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+
+def linear_warmup_schedule(
+    peak_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    """0 → peak over ``warmup_steps``, then linear decay → 0 at ``total_steps``
+    (transformers ``get_linear_schedule_with_warmup`` semantics)."""
+    warmup_steps = max(warmup_steps, 1)
+    decay_steps = max(total_steps - warmup_steps, 1)
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, peak_lr, warmup_steps),
+            optax.linear_schedule(peak_lr, 0.0, decay_steps),
+        ],
+        boundaries=[warmup_steps],
+    )
+
+
+def adamw_with_schedule(
+    config: TrainConfig, total_steps: int
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Build the optimizer chain: [global-norm clip →] bias-corrected AdamW
+    with the linear-warmup schedule. Returns (tx, schedule) — the schedule is
+    exposed separately for logging the current LR."""
+    schedule = linear_warmup_schedule(
+        config.learning_rate, config.warmup_steps, total_steps
+    )
+    components = []
+    if config.max_grad_norm and config.max_grad_norm > 0:
+        components.append(optax.clip_by_global_norm(config.max_grad_norm))
+    components.append(
+        optax.adamw(
+            learning_rate=schedule,
+            b1=config.adam_b1,
+            b2=config.adam_b2,
+            eps=config.adam_eps,
+            weight_decay=config.weight_decay,
+        )
+    )
+    return optax.chain(*components), schedule

@@ -1,0 +1,165 @@
+"""Jitted train/eval steps with structural gradient accumulation.
+
+This file replaces the reference's entire hot loop (reference
+test_data_parallelism.py:140-150; test_model_parallelism.py:283-299) with two
+compiled functions:
+
+- ``train_step(state, batch)`` — batch leaves are [accum, micro_batch, ...];
+  a ``lax.scan`` over the accumulation axis computes fp32 gradients per
+  microbatch and accumulates them in the carry, then ONE optimizer update
+  fires at the end. This is the TPU-structural equivalent of the reference's
+  ``model.no_sync()`` allreduce suppression (test_model_parallelism.py:
+  292-294): the cross-replica psum happens once per global batch because the
+  accumulated gradient is only materialized once — no flags, no off-by-one.
+  (The reference steps on ``step % accum == 0``, which fires on the very
+  first microbatch — SURVEY.md §2c-1. Here every update sees exactly
+  ``accum`` microbatches by construction.)
+- ``eval_step(state, batch)`` — forward + argmax, returning the confusion
+  counts needed for accuracy/F1 under a validity mask. Static shapes force
+  padding the last eval batch; masked counts keep the metric bit-honest
+  (fixing the reference's uneven-last-batch gather skew, SURVEY.md §2c-6)
+  and nothing bigger than a handful of scalars crosses device→host.
+
+Loss is computed in fp32 off bf16 activations; gradients accumulate in fp32.
+Jit donates ``state`` so params/optimizer state update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES
+from pytorch_distributed_training_tpu.train.state import TrainState
+
+
+def _forward_loss(state: TrainState, params, micro, dropout_rng):
+    """Mean masked softmax-CE over one microbatch, in fp32."""
+    logits = state.apply_fn(
+        {"params": params},
+        micro["input_ids"],
+        micro.get("attention_mask"),
+        micro.get("token_type_ids"),
+        deterministic=False,
+        rngs={"dropout": dropout_rng},
+    )
+    labels = micro["labels"]
+    valid = micro.get("valid")
+    if valid is None:
+        valid = jnp.ones_like(labels, jnp.float32)
+    valid = valid.astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    )
+    denom = jnp.maximum(valid.sum(), 1.0)
+    loss = (ce * valid).sum() / denom
+    return loss, logits
+
+
+def make_train_step(
+    *,
+    grad_accum_steps: int,
+    mesh: Optional[Mesh] = None,
+    state_shardings=None,
+) -> Callable:
+    """Build the jitted train step.
+
+    ``batch`` leaves: [grad_accum_steps, micro_batch, ...] (microbatch axis
+    first so ``lax.scan`` walks it). With ``mesh`` given, inputs are
+    constrained so the micro-batch dim shards over (data, fsdp) and the
+    optimizer update runs under the provided state shardings — XLA inserts
+    the per-boundary gradient AllReduce over ICI.
+    """
+
+    def train_step(state: TrainState, batch):
+        base_rng = jax.random.fold_in(state.dropout_rng, state.step)
+
+        def micro_grads(carry, micro):
+            grads_acc, loss_acc = carry
+            step_rng = jax.random.fold_in(base_rng, loss_acc[1].astype(jnp.int32))
+
+            def loss_fn(p):
+                loss, _ = _forward_loss(state, p, micro, step_rng)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            grads = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+            )
+            return (grads, (loss_acc[0] + loss, loss_acc[1] + 1.0)), None
+
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (grads, (loss_sum, _)), _ = jax.lax.scan(
+            micro_grads,
+            (zero_grads, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
+            batch,
+        )
+        grads = jax.tree.map(lambda g: g / grad_accum_steps, grads)
+        new_state = state.apply_gradients(grads)
+        metrics = {
+            "loss": loss_sum / grad_accum_steps,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    donate = (0,)
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=donate)
+    batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES))
+    return jax.jit(
+        train_step,
+        donate_argnums=donate,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+    )
+
+
+def make_eval_step(*, mesh: Optional[Mesh] = None, state_shardings=None) -> Callable:
+    """Build the jitted eval step → replicated scalar confusion counts.
+
+    Returns {"correct", "total", "tp", "fp", "fn"} summed over the (masked)
+    batch; the host-side ``MetricAccumulator`` folds batches together. The
+    positive class for binary F1 is label 1 (GLUE/MRPC convention:
+    "equivalent" == 1).
+    """
+
+    def eval_step(state: TrainState, batch):
+        logits = state.apply_fn(
+            {"params": state.params},
+            batch["input_ids"],
+            batch.get("attention_mask"),
+            batch.get("token_type_ids"),
+            deterministic=True,
+        )
+        preds = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        labels = batch["labels"]
+        valid = batch.get("valid")
+        if valid is None:
+            valid = jnp.ones_like(labels)
+        valid = valid.astype(jnp.float32)
+        correct = ((preds == labels) * valid).sum()
+        pos_pred = (preds == 1) * valid
+        pos_label = (labels == 1) * valid
+        return {
+            "correct": correct,
+            "total": valid.sum(),
+            "tp": (pos_pred * pos_label).sum(),
+            "fp": (pos_pred * (1.0 - pos_label)).sum(),
+            "fn": ((1.0 - pos_pred) * pos_label).sum(),
+        }
+
+    if mesh is None:
+        return jax.jit(eval_step)
+    batch_sharding = NamedSharding(mesh, P(BATCH_AXES))
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings={k: replicated for k in ("correct", "total", "tp", "fp", "fn")},
+    )

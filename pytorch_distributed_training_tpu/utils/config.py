@@ -91,7 +91,9 @@ class ModelConfig:
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
     num_labels: int = 2
-    attention_impl: str = "reference"  # "reference" | "flash" | "ring"
+    # "reference" (XLA einsum) | "flash" (Pallas kernel, ops/flash_attention)
+    # | "ring" (sequence-parallel, ops/ring_attention)
+    attention_impl: str = "reference"
     # dtype policy: params fp32, compute bf16 (TPU-native replacement for the
     # reference's fp16 AMP, test_data_parallelism.py:55; SURVEY.md §2b).
     compute_dtype: str = "bfloat16"
@@ -172,7 +174,9 @@ class TrainConfig:
     eval_batch_size: int = 32
     warmup_steps: int = 100
     weight_decay: float = 0.0
-    max_grad_norm: float = 1.0
+    # The reference never clips gradients (neither script calls
+    # clip_grad_norm_), so clipping is off by default; set > 0 to enable.
+    max_grad_norm: float = 0.0
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
