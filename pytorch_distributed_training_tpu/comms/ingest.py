@@ -19,13 +19,19 @@ from jax.sharding import Mesh, NamedSharding
 from pytorch_distributed_training_tpu.comms.mesh import batch_pspec
 
 
-def make_global_batch(mesh: Mesh, local_batch):
+def make_global_batch(mesh: Mesh, local_batch, pspec=None):
     """Assemble a global, batch-sharded array pytree from per-host shards.
 
-    ``local_batch`` leaves are numpy arrays whose dim 0 is this host's slice
-    of the global batch (global = local * process_count). Works unchanged in
-    single-process runs (local == global).
+    ``local_batch`` leaves are numpy arrays holding this host's slice of the
+    global batch along the sharded dim (global = local * process_count).
+    Works unchanged in single-process runs (local == global).
+
+    ``pspec`` defaults to sharding dim 0 over (data, fsdp); train batches
+    laid out [grad_accum, micro_batch, ...] pass ``P(None, BATCH_AXES)`` so
+    the accumulation axis stays whole and the micro-batch dim shards.
     """
+    sharding = NamedSharding(mesh, pspec if pspec is not None else batch_pspec())
+
     def _make(x: np.ndarray):
         x = np.asarray(x)
         if x.ndim == 0:
@@ -33,8 +39,6 @@ def make_global_batch(mesh: Mesh, local_batch):
                 "make_global_batch leaves must have a leading batch dim; "
                 "got a 0-d scalar (promote it with x[None] first)"
             )
-        # A PartitionSpec shorter than the array rank replicates trailing dims.
-        sharding = NamedSharding(mesh, batch_pspec())
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(_make, local_batch)

@@ -33,6 +33,12 @@ AXIS_NAMES = MeshConfig.AXIS_NAMES
 # Batch dimension shards over both flavors of data parallelism.
 BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
 
+# Train batches are laid out [grad_accum, micro_batch, ...]: the accumulation
+# axis stays whole (lax.scan walks it), the micro-batch dim shards. The data
+# pipeline places batches with this spec and the train step declares it as
+# in_sharding — single source of truth for the layout contract.
+TRAIN_BATCH_PSPEC = P(None, BATCH_AXES)
+
 
 def build_mesh(
     config: MeshConfig | None = None,

@@ -1,0 +1,4 @@
+from pytorch_distributed_training_tpu.data.pipeline import ShardedLoader
+from pytorch_distributed_training_tpu.data.glue import load_task_arrays
+
+__all__ = ["ShardedLoader", "load_task_arrays"]

@@ -1,0 +1,103 @@
+"""GLUE task loading → fixed-shape numpy arrays (with offline fallback).
+
+Capability twin of the reference's data pipeline: ``load_dataset("glue",
+"mrpc")`` → tokenize pairs → drop text columns → rename label→labels
+(reference test_data_parallelism.py:69-87; test_model_parallelism.py:
+194-216), but producing fixed-length arrays once up front instead of
+re-padding every batch in a collate_fn (:95-99) — on TPU one shape means one
+compiled program.
+
+Tasks: MRPC (the reference's task) and MNLI (driver config, BASELINE.json
+configs[3]). When the HF hub/cache is unreachable (this image), falls back to
+the synthetic pair task with MRPC-shaped splits so every entry point still
+runs end-to-end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.data import synthetic
+from pytorch_distributed_training_tpu.data.tokenizer import (
+    HashTokenizer,
+    WordPieceTokenizer,
+    encode_pairs,
+)
+from pytorch_distributed_training_tpu.utils.logging import log0
+
+TASKS = {
+    # task: (dataset args, text field a, text field b, num_labels)
+    "mrpc": (("glue", "mrpc"), "sentence1", "sentence2", 2),
+    "mnli": (("glue", "mnli"), "premise", "hypothesis", 3),
+    "synthetic": (None, None, None, 2),
+}
+
+
+def make_tokenizer(vocab_path: Optional[str] = None, vocab_size: int = 28996):
+    if vocab_path:
+        return WordPieceTokenizer(vocab_path)
+    return HashTokenizer(vocab_size=vocab_size)
+
+
+def resolve_task(task: str) -> str:
+    """Resolve ``"auto"`` to a concrete task ONCE (callers loading several
+    splits must not re-resolve per split — a flaky hub could silently hand
+    them different tasks for train vs validation)."""
+    if task != "auto":
+        return task
+    try:
+        import datasets
+
+        datasets.load_dataset("glue", "mrpc", split="train[:1]")
+        return "mrpc"
+    except Exception as e:  # hub unreachable / no cache
+        log0(f"glue/mrpc unavailable ({type(e).__name__}); using synthetic task")
+        return "synthetic"
+
+
+def load_task_arrays(
+    task: str,
+    split: str,
+    *,
+    max_length: int = 128,
+    vocab_path: Optional[str] = None,
+    vocab_size: int = 28996,
+    seed: int = 42,
+    synthetic_sizes: tuple[int, int] = (
+        synthetic.MRPC_TRAIN_SIZE,
+        synthetic.MRPC_EVAL_SIZE,
+    ),
+) -> tuple[dict[str, np.ndarray], int]:
+    """Return ({input_ids, attention_mask, token_type_ids, labels}, num_labels).
+
+    ``split`` is "train" or "validation". ``task="auto"`` tries MRPC and
+    falls back to synthetic when the hub/cache is unavailable.
+    """
+    if task == "auto":
+        task = resolve_task(task)
+
+    if task == "synthetic":
+        n_train, n_eval = synthetic_sizes
+        n = n_train if split == "train" else n_eval
+        data = synthetic.synthetic_pair_task(
+            n, max_length=max_length, vocab_size=vocab_size,
+            seed=seed if split == "train" else seed + 1,
+        )
+        return data, 2
+
+    if task not in TASKS:
+        raise KeyError(f"unknown task {task!r}; have {sorted(TASKS)}")
+    ds_args, field_a, field_b, num_labels = TASKS[task]
+    import datasets  # deferred: optional dependency
+
+    if task == "mnli" and split == "validation":
+        split = "validation_matched"
+    ds = datasets.load_dataset(*ds_args, split=split)
+    tokenizer = make_tokenizer(vocab_path, vocab_size)
+    arrays = encode_pairs(
+        tokenizer, ds[field_a], ds[field_b], max_length=max_length
+    )
+    arrays["labels"] = np.asarray(ds["label"], np.int32)
+    return arrays, num_labels

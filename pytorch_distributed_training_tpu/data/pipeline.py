@@ -1,0 +1,125 @@
+"""Host-sharded batching: dataset arrays → global device batches.
+
+Replaces the reference's loader stack — ``DataLoader`` + ``accelerator.
+prepare`` index sharding (reference test_data_parallelism.py:102-107,
+125-127) / ``DistributedSampler`` (test_model_parallelism.py:254-269) — with
+a TPU-shaped design:
+
+- each host slices its contiguous shard of the dataset (by process index);
+- one seeded global permutation per epoch (identical on every host, so
+  global batches are consistent — divergent orders deadlock collectives,
+  SURVEY.md §7 hard parts);
+- train batches are assembled [grad_accum, local_micro, ...] and placed as
+  ONE global sharded array per step via ``make_global_batch`` (micro dim over
+  the (data, fsdp) axes), so the whole accumulation window ships to HBM in a
+  single transfer and the step consumes it with zero further host traffic;
+- eval keeps every example exactly once: the last batch pads to the static
+  shape with ``valid=0`` rows (the masked-metric fix for the reference's
+  uneven-last-batch gather skew, SURVEY.md §2c-6).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC, dp_degree
+
+
+class ShardedLoader:
+    """Iterates global sharded batches from per-host numpy arrays.
+
+    ``data`` holds the FULL dataset on every host (GLUE-scale); each host
+    reads only its slice. ``train=True`` yields [accum, micro, ...] batches
+    (dropping the ragged tail like the reference's implicit drop behavior for
+    step-count consistency); ``train=False`` yields [batch, ...] with a
+    ``valid`` mask and keeps every example.
+    """
+
+    def __init__(
+        self,
+        data: dict[str, np.ndarray],
+        mesh: Mesh,
+        *,
+        global_batch_size: int,
+        grad_accum_steps: int = 1,
+        train: bool = True,
+        seed: int = 42,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        self.data = data
+        self.mesh = mesh
+        self.train = train
+        self.seed = seed
+        self.global_batch = global_batch_size
+        self.accum = grad_accum_steps if train else 1
+        self.n = len(next(iter(data.values())))
+        self.pidx = jax.process_index() if process_index is None else process_index
+        self.pcount = jax.process_count() if process_count is None else process_count
+        if global_batch_size % (self.accum * self.pcount):
+            raise ValueError(
+                f"global batch {global_batch_size} must divide by "
+                f"accum*processes ({self.accum}*{self.pcount})"
+            )
+        dp = dp_degree(mesh)
+        micro = global_batch_size // self.accum
+        if micro % dp:
+            # applies to eval too: eval batches shard dim 0 over dp as well
+            raise ValueError(
+                f"{'micro' if train else 'eval'} batch {micro} must divide "
+                f"by data-parallel degree {dp}"
+            )
+        self.local_per_step = global_batch_size // self.pcount
+
+    @property
+    def steps_per_epoch(self) -> int:
+        if self.train:
+            return self.n // self.global_batch
+        return math.ceil(self.n / self.global_batch)
+
+    def epoch(self, epoch_index: int = 0) -> Iterator[dict]:
+        if self.train:
+            yield from self._train_epoch(epoch_index)
+        else:
+            yield from self._eval_epoch()
+
+    # ------------------------------------------------------------- internal
+
+    def _train_epoch(self, epoch_index: int) -> Iterator[dict]:
+        # One global permutation, identical on all hosts; each host takes a
+        # strided slice of every global batch.
+        rng = np.random.default_rng((self.seed, epoch_index))
+        perm = rng.permutation(self.n)
+        micro_global = self.global_batch // self.accum
+        micro_local = micro_global // self.pcount
+        for step in range(self.steps_per_epoch):
+            idx = perm[step * self.global_batch : (step + 1) * self.global_batch]
+            idx = idx.reshape(self.accum, micro_global)
+            local = idx[:, self.pidx * micro_local : (self.pidx + 1) * micro_local]
+            batch = {k: v[local] for k, v in self.data.items()}
+            yield make_global_batch(self.mesh, batch, pspec=TRAIN_BATCH_PSPEC)
+
+    def _eval_epoch(self) -> Iterator[dict]:
+        per_host = self.global_batch // self.pcount
+        for step in range(self.steps_per_epoch):
+            lo = step * self.global_batch
+            idx_global = np.arange(lo, min(lo + self.global_batch, self.n))
+            valid_n = len(idx_global)
+            if valid_n < self.global_batch:  # pad the ragged tail
+                pad = np.zeros(self.global_batch - valid_n, np.int64)
+                idx_global = np.concatenate([idx_global, pad])
+            local_sel = idx_global[self.pidx * per_host : (self.pidx + 1) * per_host]
+            batch = {k: v[local_sel] for k, v in self.data.items()}
+            valid_global = (
+                np.arange(self.global_batch) < valid_n
+            ).astype(np.int32)
+            batch["valid"] = valid_global[
+                self.pidx * per_host : (self.pidx + 1) * per_host
+            ]
+            yield make_global_batch(self.mesh, batch)

@@ -1,0 +1,80 @@
+"""Deterministic synthetic GLUE-shaped tasks for offline/test runs.
+
+This zero-egress image cannot reach the HF hub, so the framework ships a
+synthetic sentence-pair classification task with the same tensor contract and
+split sizes as GLUE/MRPC (3668 train / 408 validation — the uneven eval split
+that forces pad-and-mask handling, SURVEY.md §7 hard parts). The task is
+*learnable* (label = whether segment B is a noised copy of segment A) so
+convergence tests and benchmarks exercise real learning dynamics, mirroring
+the reference's only verification mode — watching the eval metric rise
+(reference test_data_parallelism.py:164-166).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.data.tokenizer import (
+    PAD_ID,
+    SEP_ID,
+    assemble_pair_row,
+)
+
+MRPC_TRAIN_SIZE = 3668
+MRPC_EVAL_SIZE = 408
+
+
+def synthetic_pair_task(
+    n_examples: int,
+    *,
+    max_length: int = 128,
+    vocab_size: int = 28996,
+    num_labels: int = 2,
+    seed: int = 42,
+    seg_len_range: tuple[int, int] = (8, 40),
+) -> dict[str, np.ndarray]:
+    """Generate a paraphrase-detection-shaped dataset.
+
+    label 1: segment B = segment A with ~15% token noise (a "paraphrase");
+    label 0: segment B = unrelated random tokens. With num_labels > 2 the
+    extra classes get graded noise levels (for MNLI-shaped runs).
+    """
+    rng = np.random.default_rng(seed)
+    first = SEP_ID + 1
+    input_ids = np.full((n_examples, max_length), PAD_ID, np.int32)
+    token_type = np.zeros((n_examples, max_length), np.int32)
+    mask = np.zeros((n_examples, max_length), np.int32)
+    labels = rng.integers(0, num_labels, n_examples).astype(np.int32)
+
+    for i in range(n_examples):
+        la = int(rng.integers(*seg_len_range))
+        lb = int(rng.integers(*seg_len_range))
+        a = rng.integers(first, vocab_size, la)
+        label = labels[i]
+        if label == num_labels - 1:
+            # unrelated
+            b = rng.integers(first, vocab_size, lb)
+        else:
+            # copy of A with label-graded noise (label 0 = cleanest copy)
+            noise = 0.15 * (label + 1)
+            b = a.copy()
+            flip = rng.random(la) < noise
+            b[flip] = rng.integers(first, vocab_size, flip.sum())
+            lb = la
+        ids, types = assemble_pair_row(
+            a[:la].tolist(), b[:lb].tolist(), max_length
+        )
+        input_ids[i, : len(ids)] = ids
+        token_type[i, : len(ids)] = types
+        mask[i, : len(ids)] = 1
+
+    # For binary tasks flip so label 1 == "paraphrase" (MRPC convention);
+    # generated above: label 0 = clean copy, last label = unrelated.
+    if num_labels == 2:
+        labels = 1 - labels
+    return {
+        "input_ids": input_ids,
+        "attention_mask": mask,
+        "token_type_ids": token_type,
+        "labels": labels,
+    }

@@ -1,0 +1,221 @@
+"""The Trainer: epochs → jitted steps → eval → metrics, on any mesh/policy.
+
+Capability twin of both reference training functions (reference
+test_data_parallelism.py:53-166; test_model_parallelism.py:174-315) as ONE
+engine: the parallelism regime is entirely a (mesh shape, sharding policy,
+model) choice, so the DP entry point and the hybrid DP×MP entry point differ
+only in configuration — where the reference needed two divergent scripts
+(Accelerate-managed vs hand-rolled process groups).
+
+Per epoch: train over all global batches (each step is one compiled call
+consuming an [accum, micro, ...] sharded batch), then a masked eval pass and
+a process-0 metrics print (the reference's per-epoch ``accelerator.print``/
+rank-0 print, :164-166/:312-315) — plus samples/sec/chip, the driver's
+north-star metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.comms import initialize
+from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.data import ShardedLoader, load_task_arrays
+from pytorch_distributed_training_tpu.models import BertForSequenceClassification
+from pytorch_distributed_training_tpu.parallel import ShardingPolicy, state_shardings
+from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+from pytorch_distributed_training_tpu.train.metrics import MetricAccumulator
+from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
+from pytorch_distributed_training_tpu.train.state import create_train_state
+from pytorch_distributed_training_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_training_tpu.utils.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from pytorch_distributed_training_tpu.utils.logging import log0
+from pytorch_distributed_training_tpu.utils.profiling import (
+    annotate,
+    maybe_profile,
+    set_debug_nans,
+)
+
+
+class Trainer:
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        train_config: TrainConfig,
+        mesh_config: MeshConfig | None = None,
+        policy: ShardingPolicy | None = None,
+        *,
+        task: str = "auto",
+        model=None,
+        hf_checkpoint=None,
+    ):
+        self.mcfg = model_config
+        self.tcfg = train_config
+        self.info = initialize()
+        self.mesh = build_mesh(mesh_config)
+        self.policy = policy or ShardingPolicy()
+        if train_config.debug_nans:
+            set_debug_nans(True)
+
+        # ------------------------------------------------------------ data
+        from pytorch_distributed_training_tpu.data.glue import resolve_task
+
+        task = resolve_task(task)  # once, so both splits agree
+        train_data, num_labels = load_task_arrays(
+            task, "train",
+            max_length=train_config.max_seq_length,
+            vocab_size=model_config.vocab_size,
+            seed=train_config.seed,
+        )
+        eval_data, _ = load_task_arrays(
+            task, "validation",
+            max_length=train_config.max_seq_length,
+            vocab_size=model_config.vocab_size,
+            seed=train_config.seed,
+        )
+        if train_config.train_size:
+            train_data = {
+                k: v[: train_config.train_size] for k, v in train_data.items()
+            }
+        if train_config.eval_size:
+            eval_data = {
+                k: v[: train_config.eval_size] for k, v in eval_data.items()
+            }
+        self.mcfg.num_labels = num_labels
+        self.train_loader = ShardedLoader(
+            train_data, self.mesh,
+            global_batch_size=train_config.global_batch_size,
+            grad_accum_steps=train_config.grad_accum_steps,
+            train=True, seed=train_config.seed,
+        )
+        self.eval_loader = ShardedLoader(
+            eval_data, self.mesh,
+            global_batch_size=train_config.eval_batch_size,
+            train=False, seed=train_config.seed,
+        )
+
+        # ----------------------------------------------------------- model
+        self.model = model or BertForSequenceClassification(self.mcfg)
+        total_updates = self.train_loader.steps_per_epoch * train_config.num_epochs
+        tx, self.schedule = adamw_with_schedule(train_config, total_updates)
+        example = {
+            "input_ids": jnp.ones(
+                (2, train_config.max_seq_length), jnp.int32
+            ),
+            "attention_mask": jnp.ones(
+                (2, train_config.max_seq_length), jnp.int32
+            ),
+            "token_type_ids": jnp.zeros(
+                (2, train_config.max_seq_length), jnp.int32
+            ),
+        }
+        state = create_train_state(
+            self.model, tx, jax.random.key(train_config.seed), example
+        )
+        if hf_checkpoint is not None:
+            from pytorch_distributed_training_tpu.models.hf_loader import (
+                load_bert_classifier,
+            )
+
+            state = state.replace(
+                params=load_bert_classifier(hf_checkpoint, self.mcfg)
+            )
+        self.shardings = state_shardings(state, self.policy, self.mesh)
+        self.state = shard_state(state, self.shardings)
+
+        if train_config.resume and train_config.checkpoint_dir:
+            step = ckpt.latest_step(train_config.checkpoint_dir)
+            if step is not None:
+                self.state = ckpt.restore_checkpoint(
+                    train_config.checkpoint_dir, self.state, step=step
+                )
+
+        self.train_step = make_train_step(
+            grad_accum_steps=train_config.grad_accum_steps,
+            mesh=self.mesh,
+            state_shardings=self.shardings,
+        )
+        self.eval_step = make_eval_step(
+            mesh=self.mesh, state_shardings=self.shardings
+        )
+        self.history: list[dict] = []
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> list[dict]:
+        cfg = self.tcfg
+        n_chips = self.info.global_device_count
+        start_epoch = int(jax.device_get(self.state.step)) // max(
+            self.train_loader.steps_per_epoch, 1
+        )
+        log0(
+            f"training: {cfg.num_epochs} epochs × "
+            f"{self.train_loader.steps_per_epoch} updates "
+            f"(global batch {cfg.global_batch_size} = "
+            f"{cfg.grad_accum_steps} × {cfg.global_batch_size // cfg.grad_accum_steps}), "
+            f"mesh {dict(self.mesh.shape)}, {n_chips} chip(s)"
+        )
+        with maybe_profile(cfg.profile_dir):
+            for epoch in range(start_epoch, cfg.num_epochs):
+                epoch_t0 = time.perf_counter()
+                samples = 0
+                losses = []
+                # plain host-side counter mirrors state.step (one increment
+                # per train_step) — reading state.step back would force a
+                # host-device sync every step and serialize dispatch
+                step_no = epoch * self.train_loader.steps_per_epoch
+                for batch in self.train_loader.epoch(epoch):
+                    with annotate("train_step"):
+                        self.state, metrics = self.train_step(self.state, batch)
+                    samples += cfg.global_batch_size
+                    losses.append(metrics["loss"])
+                    step_no += 1
+                    if cfg.log_every and step_no % cfg.log_every == 0:
+                        log0(
+                            f"step {step_no}: loss="
+                            f"{float(jax.device_get(metrics['loss'])):.4f} "
+                            f"lr={float(self.schedule(step_no)):.2e}"
+                        )
+                    if (
+                        cfg.checkpoint_dir
+                        and cfg.checkpoint_every_steps
+                        and step_no % cfg.checkpoint_every_steps == 0
+                    ):
+                        ckpt.save_checkpoint(cfg.checkpoint_dir, self.state)
+                jax.block_until_ready(self.state.params)
+                train_time = time.perf_counter() - epoch_t0
+                eval_metrics = self.evaluate()
+                record = {
+                    "epoch": epoch,
+                    "train_loss": float(
+                        np.mean([float(jax.device_get(l)) for l in losses])
+                    )
+                    if losses
+                    else float("nan"),
+                    "samples_per_sec": samples / train_time,
+                    "samples_per_sec_per_chip": samples / train_time / n_chips,
+                    **eval_metrics,
+                }
+                self.history.append(record)
+                log0(f"epoch {epoch}: {record}")
+                if cfg.checkpoint_dir:
+                    ckpt.save_checkpoint(cfg.checkpoint_dir, self.state)
+        return self.history
+
+    def evaluate(self) -> dict:
+        acc = MetricAccumulator(self.mcfg.num_labels)
+        for batch in self.eval_loader.epoch():
+            with annotate("eval_step"):
+                counts = self.eval_step(self.state, batch)
+            acc.update(jax.device_get(counts))
+        return acc.compute()

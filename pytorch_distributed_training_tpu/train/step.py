@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES
+from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES, TRAIN_BATCH_PSPEC
 from pytorch_distributed_training_tpu.train.state import TrainState
 
 
@@ -111,7 +111,7 @@ def make_train_step(
     donate = (0,)
     if mesh is None:
         return jax.jit(train_step, donate_argnums=donate)
-    batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES))
+    batch_sharding = NamedSharding(mesh, TRAIN_BATCH_PSPEC)
     return jax.jit(
         train_step,
         donate_argnums=donate,

@@ -182,6 +182,9 @@ class TrainConfig:
     adam_eps: float = 1e-8
     bf16: bool = True
     max_seq_length: int = 128  # the reference's own TPU pad branch (:96-98)
+    # 0 = use the full dataset; >0 truncates (fast smoke/integration runs)
+    train_size: int = 0
+    eval_size: int = 0
     log_every: int = 50
     checkpoint_dir: str | None = None
     checkpoint_every_steps: int = 0  # 0 = per-epoch only

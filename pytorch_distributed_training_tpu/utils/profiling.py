@@ -1,0 +1,40 @@
+"""Tracing/profiling hooks (the reference has none — SURVEY.md §5).
+
+``maybe_profile`` wraps a code region in a ``jax.profiler`` trace when a
+directory is configured (view with TensorBoard/XProf or `xprof`); trace
+annotations label steps inside the timeline. ``debug_nans`` toggles JAX's
+NaN checker — jit purity makes data races structurally impossible on TPU, so
+NaN propagation is the analogous safety-net toggle here (SURVEY.md §5 race
+detection).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from pytorch_distributed_training_tpu.utils.logging import log0
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    if not trace_dir:
+        yield
+        return
+    jax.profiler.start_trace(trace_dir)
+    log0(f"profiler trace started → {trace_dir}")
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log0(f"profiler trace written → {trace_dir}")
+
+
+def annotate(name: str):
+    """Label a region in the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def set_debug_nans(enabled: bool) -> None:
+    jax.config.update("jax_debug_nans", bool(enabled))
