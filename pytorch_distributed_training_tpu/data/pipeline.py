@@ -92,8 +92,9 @@ class ShardedLoader:
     # ------------------------------------------------------------- internal
 
     def _train_epoch(self, epoch_index: int) -> Iterator[dict]:
-        # One global permutation, identical on all hosts; each host takes a
-        # strided slice of every global batch.
+        # One global permutation, identical on all hosts; each host takes its
+        # contiguous slice of every (accum-reshaped) global batch — matching
+        # make_array_from_process_local_data's process-contiguous layout.
         rng = np.random.default_rng((self.seed, epoch_index))
         perm = rng.permutation(self.n)
         micro_global = self.global_batch // self.accum
@@ -106,7 +107,7 @@ class ShardedLoader:
             yield make_global_batch(self.mesh, batch, pspec=TRAIN_BATCH_PSPEC)
 
     def _eval_epoch(self) -> Iterator[dict]:
-        per_host = self.global_batch // self.pcount
+        per_host = self.local_per_step
         for step in range(self.steps_per_epoch):
             lo = step * self.global_batch
             idx_global = np.arange(lo, min(lo + self.global_batch, self.n))

@@ -28,8 +28,51 @@ def _saveable(state: TrainState) -> dict:
     return {k: getattr(state, k) for k in _SAVEABLE}
 
 
+class Checkpointer:
+    """Long-lived checkpoint manager for a training run.
+
+    Holds ONE ``ocp.CheckpointManager`` for the run so periodic saves reuse
+    its threadpools and directory state instead of paying full setup +
+    ``wait_until_finished`` teardown per save; saves are async (orbax
+    serializes in the background while training continues) and only joined at
+    ``close()`` or when a newer save supersedes them.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, state: TrainState) -> str:
+        step = int(jax.device_get(state.step))
+        self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
+        log0(f"checkpoint saving: {self.directory}/{step}")
+        return os.path.join(self.directory, str(step))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state: TrainState, *, step: Optional[int] = None) -> TrainState:
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _saveable(state))
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        log0(f"checkpoint restored: {self.directory}/{step}")
+        return state.replace(**restored)
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
 def save_checkpoint(directory: str, state: TrainState, *, keep: int = 3) -> str:
-    """Write a sharded checkpoint at the state's current step."""
+    """One-shot sharded checkpoint save (opens/closes its own manager; use
+    ``Checkpointer`` inside training loops)."""
     directory = os.path.abspath(directory)
     step = int(jax.device_get(state.step))
     with ocp.CheckpointManager(

@@ -133,12 +133,14 @@ class Trainer:
         self.shardings = state_shardings(state, self.policy, self.mesh)
         self.state = shard_state(state, self.shardings)
 
-        if train_config.resume and train_config.checkpoint_dir:
-            step = ckpt.latest_step(train_config.checkpoint_dir)
-            if step is not None:
-                self.state = ckpt.restore_checkpoint(
-                    train_config.checkpoint_dir, self.state, step=step
-                )
+        self.checkpointer = (
+            ckpt.Checkpointer(train_config.checkpoint_dir)
+            if train_config.checkpoint_dir
+            else None
+        )
+        if train_config.resume and self.checkpointer:
+            if self.checkpointer.latest_step() is not None:
+                self.state = self.checkpointer.restore(self.state)
 
         self.train_step = make_train_step(
             grad_accum_steps=train_config.grad_accum_steps,
@@ -155,9 +157,14 @@ class Trainer:
     def run(self) -> list[dict]:
         cfg = self.tcfg
         n_chips = self.info.global_device_count
-        start_epoch = int(jax.device_get(self.state.step)) // max(
-            self.train_loader.steps_per_epoch, 1
-        )
+        spe = max(self.train_loader.steps_per_epoch, 1)
+        done_steps = int(jax.device_get(self.state.step))
+        start_epoch = done_steps // spe
+        # Mid-epoch resume: the loader's per-epoch order is deterministic
+        # (seeded by epoch index), so skipping the first `step % spe` batches
+        # of the resumed epoch continues the exact optimizer/data trajectory —
+        # no sample is trained twice and the LR schedule stays on its course.
+        skip_in_first_epoch = done_steps % spe
         log0(
             f"training: {cfg.num_epochs} epochs × "
             f"{self.train_loader.steps_per_epoch} updates "
@@ -174,7 +181,11 @@ class Trainer:
                 # per train_step) — reading state.step back would force a
                 # host-device sync every step and serialize dispatch
                 step_no = epoch * self.train_loader.steps_per_epoch
-                for batch in self.train_loader.epoch(epoch):
+                skip = skip_in_first_epoch if epoch == start_epoch else 0
+                for i, batch in enumerate(self.train_loader.epoch(epoch)):
+                    if i < skip:
+                        step_no += 1
+                        continue
                     with annotate("train_step"):
                         self.state, metrics = self.train_step(self.state, batch)
                     samples += cfg.global_batch_size
@@ -187,11 +198,11 @@ class Trainer:
                             f"lr={float(self.schedule(step_no)):.2e}"
                         )
                     if (
-                        cfg.checkpoint_dir
+                        self.checkpointer
                         and cfg.checkpoint_every_steps
                         and step_no % cfg.checkpoint_every_steps == 0
                     ):
-                        ckpt.save_checkpoint(cfg.checkpoint_dir, self.state)
+                        self.checkpointer.save(self.state)
                 jax.block_until_ready(self.state.params)
                 train_time = time.perf_counter() - epoch_t0
                 eval_metrics = self.evaluate()
@@ -208,8 +219,10 @@ class Trainer:
                 }
                 self.history.append(record)
                 log0(f"epoch {epoch}: {record}")
-                if cfg.checkpoint_dir:
-                    ckpt.save_checkpoint(cfg.checkpoint_dir, self.state)
+                if self.checkpointer:
+                    self.checkpointer.save(self.state)
+        if self.checkpointer:
+            self.checkpointer.close()
         return self.history
 
     def evaluate(self) -> dict:

@@ -56,6 +56,37 @@ def test_trainer_learns_and_reports(trained):
     assert history[-1]["samples_per_sec_per_chip"] > 0
 
 
+def test_midepoch_resume_continues_trajectory(eight_devices, tmp_path):
+    """A run that checkpoints mid-epoch and resumes must land on the same
+    final step count and params as an uninterrupted run (no batch trained
+    twice, LR schedule on course)."""
+    import jax
+
+    d = str(tmp_path / "mid")
+    kw = dict(num_epochs=1, train_size=256, eval_size=32)
+    # uninterrupted run: 8 updates
+    full = small_trainer(**kw)
+    full.run()
+    full_steps = int(jax.device_get(full.state.step))
+    assert full_steps == 8
+
+    # interrupted: checkpoint every 3 steps, pretend crash after step 6 by
+    # restoring the step-6 checkpoint into a resuming trainer
+    part = small_trainer(checkpoint_dir=d, checkpoint_every_steps=3, **kw)
+    part.run()
+    resumed = small_trainer(checkpoint_dir=d, resume=True, **kw)
+    resumed.state = resumed.checkpointer.restore(resumed.state, step=6)
+    resumed.run()
+    assert int(jax.device_get(resumed.state.step)) == full_steps
+    a = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(full.state.params)]
+    )
+    b = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(resumed.state.params)]
+    )
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
 def test_checkpoint_save_restore_resume(trained, tmp_path):
     import jax
 
