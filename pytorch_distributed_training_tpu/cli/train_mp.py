@@ -1,0 +1,101 @@
+"""Hybrid data × model parallel trainer entry point.
+
+Capability twin of the reference's MP script (reference
+test_model_parallelism.py): fine-tune under data parallelism wrapping a
+model-parallel module. Two model-parallel modes, matching the reference's two
+custom modules:
+
+- ``--mp-mode branch`` (default) — 3-branch ensemble with shared embeddings
+  and mean-fused hidden states (TriBert, :92-163). The branch axis shards
+  over the mesh ``model`` axis so branches run concurrently on disjoint
+  slices (the reference serializes them on two shared GPUs, :120-137).
+- ``--mp-mode stage``  — layer split over the mesh ``stage`` axis
+  (ConcatBert's 2-stage split, :40-89, generalized to any stage count via
+  scan-stacked layers).
+
+Launch (one process per host; mesh axes replace ``mp.spawn`` + hardcoded
+``cuda:1``/``cuda:0`` placement, :190-191,331-335):
+
+    python -m pytorch_distributed_training_tpu.cli.train_mp \
+        --model bert-base-cased --mesh-data 2 --mesh-model 2
+
+The reference's MP script has no fp16 (:320-321); here bf16 is on by default
+like every entry point — pass ``--no-bf16`` for fp32 parity runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pytorch_distributed_training_tpu.models import BranchEnsembleClassifier
+from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+from pytorch_distributed_training_tpu.train.loop import Trainer
+from pytorch_distributed_training_tpu.utils.config import (
+    MeshConfig,
+    TrainConfig,
+    add_dataclass_args,
+    dataclass_from_args,
+    model_preset,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="bert-base-cased",
+                   help="model preset (the reference MP script uses "
+                        "bert-base-cased ×3, test_model_parallelism.py:230-238)")
+    p.add_argument("--task", default="auto",
+                   help="mrpc | mnli | synthetic | auto (mrpc w/ fallback)")
+    p.add_argument("--mp-mode", default="branch", choices=["branch", "stage"],
+                   help="branch = TriBert-style ensemble over the model axis; "
+                        "stage = ConcatBert-style layer split over the stage axis")
+    p.add_argument("--n-branches", type=int, default=3)
+    p.add_argument("--attention", default="reference")
+    p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--mesh-data", type=int, default=-1)
+    p.add_argument("--mesh-fsdp", type=int, default=1)
+    p.add_argument("--mesh-stage", type=int, default=1)
+    p.add_argument("--mesh-model", type=int, default=1)
+    add_dataclass_args(p, TrainConfig)
+    return p
+
+
+def main(argv=None) -> list[dict]:
+    args = build_parser().parse_args(argv)
+    tcfg = dataclass_from_args(TrainConfig, args)
+    mcfg = model_preset(
+        args.model,
+        compute_dtype="bfloat16" if tcfg.bf16 else "float32",
+        attention_impl=args.attention,
+        scan_layers=args.mp_mode == "stage",
+    )
+    mesh_cfg = MeshConfig(
+        data=args.mesh_data, fsdp=args.mesh_fsdp,
+        stage=args.mesh_stage, model=args.mesh_model,
+    )
+    if args.mp_mode == "branch":
+        if args.mesh_model > 1 and args.n_branches % args.mesh_model:
+            raise SystemExit(
+                f"--n-branches {args.n_branches} must be divisible by "
+                f"--mesh-model {args.mesh_model} for branch parallelism "
+                f"(each model-axis slice holds n_branches/mesh_model branches)"
+            )
+        model = BranchEnsembleClassifier(mcfg, n_branches=args.n_branches)
+        policy = ShardingPolicy(branch=True, fsdp=args.fsdp)
+    else:
+        if args.mesh_stage > 1 and mcfg.num_layers % args.mesh_stage:
+            raise SystemExit(
+                f"model has {mcfg.num_layers} layers, not divisible by "
+                f"--mesh-stage {args.mesh_stage} — the layer split would "
+                f"silently replicate instead of sharding"
+            )
+        model = None  # Trainer default: BertForSequenceClassification
+        policy = ShardingPolicy(stage=True, fsdp=args.fsdp)
+    trainer = Trainer(
+        mcfg, tcfg, mesh_cfg, policy, task=args.task, model=model
+    )
+    return trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -2,5 +2,12 @@ from pytorch_distributed_training_tpu.models.bert import (
     BertEncoderModel,
     BertForSequenceClassification,
 )
+from pytorch_distributed_training_tpu.models.branch import (
+    BranchEnsembleClassifier,
+)
 
-__all__ = ["BertEncoderModel", "BertForSequenceClassification"]
+__all__ = [
+    "BertEncoderModel",
+    "BertForSequenceClassification",
+    "BranchEnsembleClassifier",
+]

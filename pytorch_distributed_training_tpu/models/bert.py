@@ -130,6 +130,74 @@ class BertLayer(nn.Module):
         return x.astype(_dtype(cfg))
 
 
+def default_position_ids(cfg: ModelConfig, input_ids):
+    """Position ids per model family: RoBERTa counts non-pad tokens offset
+    past the pad id; BERT uses plain arange. Shared by every trunk (single
+    encoder AND the branch ensemble) so family semantics can't drift."""
+    batch, seq = input_ids.shape
+    if cfg.roberta_style:
+        mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+        return jnp.cumsum(mask, axis=-1) * mask + cfg.pad_token_id
+    return jnp.broadcast_to(
+        jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
+    )
+
+
+def run_layers(cfg: ModelConfig, x, attention_bias, deterministic):
+    """The python-loop trunk body (layer_0..layer_{N-1}), shared by
+    BertEncoderModel's non-scan path and each ensemble branch. Must be called
+    from inside an ``@nn.compact`` ``__call__`` (submodules register in the
+    caller's scope, keeping the flat ``layer_i`` param names)."""
+    layer_cls = BertLayer
+    if cfg.remat:
+        layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+    for i in range(cfg.num_layers):
+        x = layer_cls(cfg, name=f"layer_{i}")(x, attention_bias, deterministic)
+    return x
+
+
+def pool_cls(cfg: ModelConfig, x, deterministic):
+    """CLS pooling head: [roberta pre-dropout →] dense('pooler') → tanh.
+
+    RobertaClassificationHead applies dropout BEFORE its dense (dropout →
+    dense → tanh → dropout → out_proj); BERT's pooler does not. Keeping the
+    distinction here — shared by all classifiers — regularizes fine-tuning
+    identically to the respective HF heads."""
+    cls = x[:, 0]
+    if cfg.roberta_style:
+        cls = nn.Dropout(cfg.hidden_dropout)(cls, deterministic=deterministic)
+    pooled = nn.Dense(
+        cfg.hidden_size, dtype=x.dtype, param_dtype=_pdtype(cfg),
+        kernel_init=nn.initializers.normal(stddev=0.02), name="pooler",
+    )(cls)
+    return jnp.tanh(pooled)
+
+
+def classify(cfg: ModelConfig, pooled, deterministic):
+    """dropout → fp32 dense('classifier') → logits, shared by all heads."""
+    pooled = nn.Dropout(cfg.hidden_dropout)(pooled, deterministic=deterministic)
+    return nn.Dense(
+        cfg.num_labels, dtype=jnp.float32, param_dtype=_pdtype(cfg),
+        kernel_init=nn.initializers.normal(stddev=0.02), name="classifier",
+    )(pooled.astype(jnp.float32))
+
+
+class _ScanBlock(nn.Module):
+    """One layer in (carry, x) scan form for ``nn.scan`` stacking."""
+
+    config: ModelConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x, attention_bias):
+        cfg = self.config
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        x = layer_cls(cfg, name="layer")(x, attention_bias, self.deterministic)
+        return x, None
+
+
 class BertEncoderModel(nn.Module):
     """Embeddings + N layers + pooler → (sequence_output, pooled_output)."""
 
@@ -145,43 +213,36 @@ class BertEncoderModel(nn.Module):
         deterministic: bool = True,
     ):
         cfg = self.config
-        batch, seq = input_ids.shape
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         if position_ids is None:
-            if cfg.roberta_style:
-                # RoBERTa: positions count non-pad tokens, offset past pad id.
-                mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
-                position_ids = jnp.cumsum(mask, axis=-1) * mask + cfg.pad_token_id
-            else:
-                position_ids = jnp.broadcast_to(
-                    jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
-                )
+            position_ids = default_position_ids(cfg, input_ids)
 
         x = BertEmbeddings(cfg, name="embeddings")(
             input_ids, token_type_ids, position_ids, deterministic
         )
         bias = make_attention_bias(attention_mask)
 
-        layer_cls = BertLayer
-        if cfg.remat:
-            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
-        for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, bias, deterministic)
+        if cfg.scan_layers:
+            # Layers stacked on a leading [num_layers] param dim and walked
+            # with ONE traced body (lax.scan): near-constant compile time in
+            # depth, and the layer dim becomes shardable — the mesh ``stage``
+            # axis splits it into contiguous layer blocks per stage slice,
+            # the GSPMD generalization of the reference ConcatBert's 2-stage
+            # layer split (test_model_parallelism.py:40-89, where stage
+            # transfer was a hand-written ``.to(second_device)`` at :62-63).
+            scan = nn.scan(
+                _ScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.num_layers,
+            )
+            x, _ = scan(cfg, deterministic, name="layers_scan")(x, bias)
+        else:
+            x = run_layers(cfg, x, bias, deterministic)
 
-        cls = x[:, 0]
-        if cfg.roberta_style:
-            # RobertaClassificationHead applies dropout BEFORE its dense
-            # (dropout → dense → tanh → dropout → out_proj); BERT's pooler
-            # does not. Keep the distinction so fine-tuning regularizes
-            # identically to the respective HF heads.
-            cls = nn.Dropout(cfg.hidden_dropout)(cls, deterministic=deterministic)
-        pooled = nn.Dense(
-            cfg.hidden_size, dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
-            kernel_init=nn.initializers.normal(stddev=0.02), name="pooler",
-        )(cls)
-        pooled = jnp.tanh(pooled)
-        return x, pooled
+        return x, pool_cls(cfg, x, deterministic)
 
 
 class BertForSequenceClassification(nn.Module):
@@ -208,11 +269,4 @@ class BertForSequenceClassification(nn.Module):
             input_ids, attention_mask, token_type_ids, position_ids,
             deterministic,
         )
-        pooled = nn.Dropout(cfg.hidden_dropout)(
-            pooled, deterministic=deterministic
-        )
-        logits = nn.Dense(
-            cfg.num_labels, dtype=jnp.float32, param_dtype=_pdtype(cfg),
-            kernel_init=nn.initializers.normal(stddev=0.02), name="classifier",
-        )(pooled.astype(jnp.float32))
-        return logits
+        return classify(cfg, pooled, deterministic)

@@ -1,0 +1,105 @@
+"""Branch-ensemble classifier: the TriBert capability, TPU-first.
+
+The reference's ``TriBert`` (reference test_model_parallelism.py:92-163) is a
+3-branch ensemble: shared input embeddings from bert_1 (:114,118), each branch
+a full BERT encoder on its own device (:98-103,120-137), branch outputs moved
+back to one device and ``stack(dim=1).mean(dim=1)``-fused (:139-148), then
+bert_1's pooler/dropout/classifier produce logits (:149-151).
+
+TPU-first redesign — no ``.to(device)`` shuttling, no serialized branches:
+
+- the branch dimension is a *parameter axis*: ``nn.vmap`` stacks the three
+  encoders' weights with a leading [n_branches, ...] dim, and the sharding
+  policy maps that dim onto the mesh's ``model`` axis — so each mesh slice
+  holds exactly one branch's weights and all branches run CONCURRENTLY
+  (the reference executes them sequentially, :120-137; SURVEY.md §7 calls
+  out doing better);
+- the embedded input is broadcast to branches (``in_axes=None``) — the
+  shared-embedding semantics of :114,118;
+- the mean over the branch axis is the fuse (:148); under branch sharding
+  XLA lowers it to one small all-reduce over ``model`` — the only
+  cross-branch communication in the whole forward.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.models.bert import (
+    BertEmbeddings,
+    classify,
+    default_position_ids,
+    pool_cls,
+    run_layers,
+)
+from pytorch_distributed_training_tpu.ops.attention import make_attention_bias
+from pytorch_distributed_training_tpu.utils.config import ModelConfig
+
+BRANCH_MODULE = "branches"  # param-tree key the sharding policy matches on
+
+
+class _EncoderStack(nn.Module):
+    """N transformer layers — one ensemble branch (no embeddings/pooler)."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, deterministic):
+        return run_layers(self.config, x, attention_bias, deterministic)
+
+
+class BranchEnsembleClassifier(nn.Module):
+    """n_branches parallel encoders over shared embeddings → mean-fused CLS.
+
+    Semantics of reference TriBert.forward (test_model_parallelism.py:
+    105-163): shared embeddings → per-branch encoders → stack+mean fuse →
+    pooler → dropout → classifier. Loss lives in the train step.
+    """
+
+    config: ModelConfig
+    n_branches: int = 3
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        position_ids=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = default_position_ids(cfg, input_ids)
+
+        # Shared embeddings (the reference reuses bert_1's embedding table
+        # for every branch, :114,118) — computed ONCE, broadcast to branches.
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        bias = make_attention_bias(attention_mask)
+
+        # vmap over the branch axis: params gain a leading [n_branches] dim
+        # (sharded over the mesh "model" axis by ShardingPolicy(branch=True)),
+        # inputs broadcast, outputs stack on axis 0.
+        branches = nn.vmap(
+            _EncoderStack,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(None, None, None),
+            out_axes=0,
+            axis_size=self.n_branches,
+            methods=["__call__"],
+        )(cfg, name=BRANCH_MODULE)
+        hidden = branches(x, bias, deterministic)  # [n_branches, B, S, H]
+
+        # stack+mean fuse (reference :139-148); in fp32 like the reference's
+        # fp32 path, then back to the compute dtype.
+        fused = jnp.mean(hidden.astype(jnp.float32), axis=0)
+        fused = fused.astype(x.dtype)
+
+        pooled = pool_cls(cfg, fused, deterministic)
+        return classify(cfg, pooled, deterministic)

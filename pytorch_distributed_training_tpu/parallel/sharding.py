@@ -35,6 +35,14 @@ from pytorch_distributed_training_tpu.train.state import TrainState
 class ShardingPolicy:
     tp: bool = False  # shard transformer blocks over the "model" axis
     fsdp: bool = False  # shard remaining/bigger dims over the "fsdp" axis
+    # branch-ensemble parallelism (the TriBert twin, models/branch.py): the
+    # leading [n_branches] param dim shards over "model", so each model-axis
+    # slice holds and runs exactly one branch.
+    branch: bool = False
+    # stage/layer-split parallelism (the ConcatBert twin): the leading
+    # [num_layers] dim of scan-stacked layers (ModelConfig.scan_layers)
+    # shards over "stage" — contiguous layer blocks per stage slice.
+    stage: bool = False
     # minimum leaf size (elements) before fsdp sharding kicks in; tiny
     # params (norms, biases) stay replicated — sharding them costs more in
     # collective latency than it saves in HBM.
@@ -104,8 +112,26 @@ def _leaf_spec(path, leaf, policy: ShardingPolicy, mesh: Mesh) -> P:
         p.key if hasattr(p, "key") else getattr(p, "name", str(p)) for p in path
     )
     spec = None
-    if policy.tp and mesh.shape["model"] > 1:
-        spec = _tp_spec(names, leaf.ndim)
+    # Stacked-param axes first: "branches" (vmapped ensemble, models/branch)
+    # and "layers_scan" (scan-stacked layers) carry an extra leading dim that
+    # shards over model/stage respectively; the per-layer rules (tp) then
+    # apply to the trailing dims.
+    lead = None
+    if policy.branch and "branches" in names and mesh.shape["model"] > 1:
+        lead = "model"
+    elif policy.stage and "layers_scan" in names and mesh.shape["stage"] > 1:
+        lead = "stage"
+    if lead and leaf.shape[0] % mesh.shape[lead]:
+        # stacked dim (n_branches / num_layers) not divisible by the axis —
+        # replicate rather than crash; the caller picked an odd mesh.
+        lead = None
+    inner_ndim = leaf.ndim - (1 if lead else 0)
+    if policy.tp and mesh.shape["model"] > 1 and lead != "model":
+        spec = _tp_spec(names, inner_ndim)
+    if lead:
+        inner = list(spec) if spec is not None else []
+        inner += [None] * (inner_ndim - len(inner))
+        spec = P(lead, *inner)
     if policy.fsdp:
         spec = _add_fsdp(spec, leaf.shape, mesh.shape["fsdp"], policy.fsdp_min_size)
     return spec if spec is not None else P()

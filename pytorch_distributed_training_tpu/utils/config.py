@@ -104,6 +104,11 @@ class ModelConfig:
     roberta_style: bool = False
     pad_token_id: int = 0
     remat: bool = False  # jax.checkpoint each layer (trade FLOPs for HBM)
+    # Stack layers on a leading [num_layers] param dim walked by lax.scan:
+    # near-constant compile time in depth, and the layer dim shards over the
+    # mesh "stage" axis (ShardingPolicy(stage=True)) — the 2-stage layer
+    # split capability (reference ConcatBert, test_model_parallelism.py:40-89)
+    scan_layers: bool = False
 
     @property
     def head_dim(self) -> int:
