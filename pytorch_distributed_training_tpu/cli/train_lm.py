@@ -1,0 +1,72 @@
+"""Causal-LM trainer entry point (FSDP-style param sharding by default).
+
+The reference repo has no decoder/LM training; this entry point exists for
+the driver config "GPT-2-medium causal-LM fine-tune, FSDP-style param
+sharding on v5p-32" (/root/repo/BASELINE.json configs[4]). FSDP here is not
+a separate engine: it is the ``fsdp`` mesh axis + ``ShardingPolicy(fsdp=
+True)`` — parameters and Adam moments shard one eligible dim over the axis,
+XLA emits the all-gather/reduce-scatter pairs (ZeRO-3 semantics; SURVEY.md
+§2d).
+
+    python -m pytorch_distributed_training_tpu.cli.train_lm \
+        --model gpt2-medium --mesh-fsdp 8
+
+Reports eval loss / perplexity / next-token accuracy per epoch.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+from pytorch_distributed_training_tpu.train.loop import Trainer
+from pytorch_distributed_training_tpu.utils.config import (
+    MeshConfig,
+    TrainConfig,
+    add_dataclass_args,
+    dataclass_from_args,
+    model_preset,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="gpt2-medium")
+    p.add_argument("--task", default="lm",
+                   help="lm (synthetic causal-LM corpus)")
+    p.add_argument("--attention", default="reference")
+    p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--tp", action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--scan-layers", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--mesh-data", type=int, default=1)
+    p.add_argument("--mesh-fsdp", type=int, default=-1)
+    p.add_argument("--mesh-model", type=int, default=1)
+    add_dataclass_args(p, TrainConfig)
+    return p
+
+
+def main(argv=None) -> list[dict]:
+    args = build_parser().parse_args(argv)
+    tcfg = dataclass_from_args(TrainConfig, args)
+    mcfg = model_preset(
+        args.model,
+        compute_dtype="bfloat16" if tcfg.bf16 else "float32",
+        attention_impl=args.attention,
+        scan_layers=args.scan_layers,
+    )
+    if not mcfg.causal:
+        raise SystemExit(
+            f"--model {args.model} is not a causal/decoder preset; "
+            f"use gpt2-medium (or set causal=True on a custom config)"
+        )
+    mesh_cfg = MeshConfig(
+        data=args.mesh_data, fsdp=args.mesh_fsdp, model=args.mesh_model
+    )
+    policy = ShardingPolicy(fsdp=args.fsdp, tp=args.tp)
+    trainer = Trainer(mcfg, tcfg, mesh_cfg, policy, task=args.task)
+    return trainer.run()
+
+
+if __name__ == "__main__":
+    main()

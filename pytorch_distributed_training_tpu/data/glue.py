@@ -32,6 +32,8 @@ TASKS = {
     "mrpc": (("glue", "mrpc"), "sentence1", "sentence2", 2),
     "mnli": (("glue", "mnli"), "premise", "hypothesis", 3),
     "synthetic": (None, None, None, 2),
+    # causal-LM corpus (synthetic Markov chain; BASELINE.json configs[4])
+    "lm": (None, None, None, 0),
 }
 
 
@@ -86,6 +88,18 @@ def load_task_arrays(
             seed=seed if split == "train" else seed + 1,
         )
         return data, 2
+
+    if task == "lm":
+        # One corpus from one chain (same seed), split into disjoint rows:
+        # eval measures how well the model learned the shared transition
+        # table on rows it never saw.
+        n_train, n_eval = synthetic_sizes
+        data = synthetic.synthetic_lm_task(
+            n_train + n_eval, max_length=max_length, vocab_size=vocab_size,
+            seed=seed,
+        )
+        sl = slice(0, n_train) if split == "train" else slice(n_train, None)
+        return {k: v[sl] for k, v in data.items()}, 0
 
     if task not in TASKS:
         raise KeyError(f"unknown task {task!r}; have {sorted(TASKS)}")

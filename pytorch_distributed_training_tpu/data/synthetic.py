@@ -24,6 +24,46 @@ MRPC_TRAIN_SIZE = 3668
 MRPC_EVAL_SIZE = 408
 
 
+def synthetic_lm_task(
+    n_examples: int,
+    *,
+    max_length: int = 128,
+    vocab_size: int = 50257,
+    seed: int = 42,
+    order: int = 1,
+) -> dict[str, np.ndarray]:
+    """Learnable causal-LM corpus: a fixed random order-``order`` Markov
+    chain over a small token alphabet, embedded in the full vocab.
+
+    A model that learns the transition table drives next-token loss well
+    below the uniform-over-alphabet floor, so LM convergence tests and
+    benchmarks see real learning dynamics (the LM analogue of the
+    paraphrase-shaped task above). Dense rows — no padding — matching
+    packed-sequence LM training.
+    """
+    rng = np.random.default_rng(seed)
+    alphabet = 256  # tokens 2..258: leave 0/1 for pad/eos conventions
+    # sparse-ish transition table: each context strongly prefers 4 tokens
+    table = rng.dirichlet(np.full(4, 0.5), size=alphabet**order)
+    cum = table.cumsum(axis=1)
+    prefs = rng.integers(0, alphabet, size=(alphabet**order, 4))
+
+    ids = np.empty((n_examples, max_length), np.int64)
+    ids[:, :order] = rng.integers(0, alphabet, size=(n_examples, order))
+    for t in range(order, max_length):
+        ctx = ids[:, t - order]
+        for k in range(1, order):
+            ctx = ctx * alphabet + ids[:, t - order + k]
+        u = rng.random(n_examples)
+        choice = (u[:, None] > cum[ctx]).sum(axis=1).clip(0, 3)
+        ids[:, t] = prefs[ctx, choice]
+    ids = (ids + 2) % vocab_size
+    return {
+        "input_ids": ids.astype(np.int32),
+        "attention_mask": np.ones((n_examples, max_length), np.int32),
+    }
+
+
 def synthetic_pair_task(
     n_examples: int,
     *,

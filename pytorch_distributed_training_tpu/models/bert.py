@@ -143,16 +143,23 @@ def default_position_ids(cfg: ModelConfig, input_ids):
     )
 
 
+def _layer_cls(cfg: ModelConfig):
+    """BertLayer, remat-wrapped when configured — the ONE place the
+    nn.remat/static_argnums contract with BertLayer.__call__ is encoded."""
+    if cfg.remat:
+        return nn.remat(BertLayer, static_argnums=(3,))
+    return BertLayer
+
+
 def run_layers(cfg: ModelConfig, x, attention_bias, deterministic):
     """The python-loop trunk body (layer_0..layer_{N-1}), shared by
     BertEncoderModel's non-scan path and each ensemble branch. Must be called
     from inside an ``@nn.compact`` ``__call__`` (submodules register in the
     caller's scope, keeping the flat ``layer_i`` param names)."""
-    layer_cls = BertLayer
-    if cfg.remat:
-        layer_cls = nn.remat(BertLayer, static_argnums=(3,))
     for i in range(cfg.num_layers):
-        x = layer_cls(cfg, name=f"layer_{i}")(x, attention_bias, deterministic)
+        x = _layer_cls(cfg)(cfg, name=f"layer_{i}")(
+            x, attention_bias, deterministic
+        )
     return x
 
 
@@ -191,10 +198,9 @@ class _ScanBlock(nn.Module):
     @nn.compact
     def __call__(self, x, attention_bias):
         cfg = self.config
-        layer_cls = BertLayer
-        if cfg.remat:
-            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
-        x = layer_cls(cfg, name="layer")(x, attention_bias, self.deterministic)
+        x = _layer_cls(cfg)(cfg, name="layer")(
+            x, attention_bias, self.deterministic
+        )
         return x, None
 
 

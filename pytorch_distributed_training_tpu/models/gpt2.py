@@ -1,0 +1,135 @@
+"""GPT-2 causal language model (flax.linen), TPU-first.
+
+The reference repo has no decoder models — this family exists for the
+driver's extra config "GPT-2-medium causal-LM fine-tune, FSDP-style param
+sharding" (/root/repo/BASELINE.json configs[4]). Architecture follows GPT-2:
+pre-LN transformer blocks, learned absolute positions, tanh-approximate GELU,
+final LayerNorm, and a weight-tied LM head (logits = h @ wte.T).
+
+Reuses this framework's attention stack (``BertSelfAttention`` with
+``config.causal=True`` → causal masking inside the swappable attention op)
+and the same dtype policy (params fp32, compute bf16, LayerNorm/softmax
+fp32). ``config.scan_layers`` stacks blocks on a leading [num_layers] dim
+(lax.scan trunk) exactly like the encoder, so the stage/FSDP sharding rules
+apply unchanged.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.models.bert import (
+    BertSelfAttention,
+    _dtype,
+    _pdtype,
+)
+from pytorch_distributed_training_tpu.ops.attention import make_attention_bias
+from pytorch_distributed_training_tpu.utils.config import ModelConfig
+
+
+class GPT2Block(nn.Module):
+    """Pre-LN transformer block (GPT-2 convention — LN before each sublayer,
+    unlike BERT's post-LN ``BertLayer``)."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, deterministic):
+        cfg = self.config
+        kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
+                  kernel_init=nn.initializers.normal(stddev=0.02))
+        ln = dict(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                  param_dtype=_pdtype(cfg))
+
+        h = nn.LayerNorm(**ln, name="ln_1")(x).astype(_dtype(cfg))
+        h = BertSelfAttention(cfg, name="attention")(
+            h, attention_bias, deterministic
+        )
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        x = x + h
+
+        h = nn.LayerNorm(**ln, name="ln_2")(x).astype(_dtype(cfg))
+        h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(h)
+        h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
+        h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class _GPT2ScanBlock(nn.Module):
+    config: ModelConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, x, attention_bias):
+        x = GPT2Block(self.config, name="block")(
+            x, attention_bias, self.deterministic
+        )
+        return x, None
+
+
+class GPT2LMModel(nn.Module):
+    """wte+wpe embeddings → N pre-LN blocks → ln_f → tied-head logits.
+
+    Signature matches the encoder classifiers (token_type_ids accepted and
+    ignored) so train/eval steps and the Trainer drive either family
+    unchanged.
+    """
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,  # unused; uniform model signature
+        position_ids=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(
+                jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
+            )
+        embed_init = nn.initializers.normal(stddev=0.02)
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, embedding_init=embed_init,
+            dtype=_dtype(cfg), param_dtype=_pdtype(cfg), name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            embedding_init=embed_init, dtype=_dtype(cfg),
+            param_dtype=_pdtype(cfg), name="wpe",
+        )
+        x = wte(input_ids) + wpe(position_ids)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+        # padding bias (causal masking is applied inside attention via
+        # cfg.causal; GPT-2 training batches are usually dense so
+        # attention_mask may be None)
+        bias = make_attention_bias(attention_mask)
+
+        if cfg.scan_layers:
+            scan = nn.scan(
+                _GPT2ScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.num_layers,
+            )
+            x, _ = scan(cfg, deterministic, name="layers_scan")(x, bias)
+        else:
+            for i in range(cfg.num_layers):
+                x = GPT2Block(cfg, name=f"block_{i}")(x, bias, deterministic)
+
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+            param_dtype=_pdtype(cfg), name="ln_f",
+        )(x)
+        # Tied LM head: logits share the input embedding matrix (GPT-2
+        # convention), computed in fp32 for a stable softmax-CE.
+        logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        return logits

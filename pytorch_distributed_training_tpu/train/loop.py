@@ -71,6 +71,14 @@ class Trainer:
         from pytorch_distributed_training_tpu.data.glue import resolve_task
 
         task = resolve_task(task)  # once, so both splits agree
+        self.objective = "causal_lm" if task == "lm" else "classification"
+        if (self.objective == "causal_lm") != bool(model_config.causal):
+            raise ValueError(
+                f"task {task!r} implies objective {self.objective!r} but the "
+                f"model config has causal={model_config.causal} — use a "
+                f"decoder preset (gpt2-*) with --task lm, an encoder preset "
+                f"with classification tasks"
+            )
         train_data, num_labels = load_task_arrays(
             task, "train",
             max_length=train_config.max_seq_length,
@@ -91,7 +99,8 @@ class Trainer:
             eval_data = {
                 k: v[: train_config.eval_size] for k, v in eval_data.items()
             }
-        self.mcfg.num_labels = num_labels
+        if num_labels:
+            self.mcfg.num_labels = num_labels
         self.train_loader = ShardedLoader(
             train_data, self.mesh,
             global_batch_size=train_config.global_batch_size,
@@ -105,7 +114,16 @@ class Trainer:
         )
 
         # ----------------------------------------------------------- model
-        self.model = model or BertForSequenceClassification(self.mcfg)
+        if model is None:
+            if self.mcfg.causal:
+                from pytorch_distributed_training_tpu.models.gpt2 import (
+                    GPT2LMModel,
+                )
+
+                model = GPT2LMModel(self.mcfg)
+            else:
+                model = BertForSequenceClassification(self.mcfg)
+        self.model = model
         total_updates = self.train_loader.steps_per_epoch * train_config.num_epochs
         tx, self.schedule = adamw_with_schedule(train_config, total_updates)
         example = {
@@ -146,9 +164,11 @@ class Trainer:
             grad_accum_steps=train_config.grad_accum_steps,
             mesh=self.mesh,
             state_shardings=self.shardings,
+            objective=self.objective,
         )
         self.eval_step = make_eval_step(
-            mesh=self.mesh, state_shardings=self.shardings
+            mesh=self.mesh, state_shardings=self.shardings,
+            objective=self.objective,
         )
         self.history: list[dict] = []
 
@@ -226,7 +246,14 @@ class Trainer:
         return self.history
 
     def evaluate(self) -> dict:
-        acc = MetricAccumulator(self.mcfg.num_labels)
+        if self.objective == "causal_lm":
+            from pytorch_distributed_training_tpu.train.metrics import (
+                LMMetricAccumulator,
+            )
+
+            acc = LMMetricAccumulator()
+        else:
+            acc = MetricAccumulator(self.mcfg.num_labels)
         for batch in self.eval_loader.epoch():
             with annotate("eval_step"):
                 counts = self.eval_step(self.state, batch)

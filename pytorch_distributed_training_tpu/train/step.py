@@ -37,16 +37,21 @@ from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES, TRAIN_BATCH_
 from pytorch_distributed_training_tpu.train.state import TrainState
 
 
-def _forward_loss(state: TrainState, params, micro, dropout_rng):
-    """Mean masked softmax-CE over one microbatch, in fp32."""
-    logits = state.apply_fn(
+def _apply(state: TrainState, params, micro, dropout_rng):
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    return state.apply_fn(
         {"params": params},
         micro["input_ids"],
         micro.get("attention_mask"),
         micro.get("token_type_ids"),
-        deterministic=False,
-        rngs={"dropout": dropout_rng},
+        deterministic=dropout_rng is None,
+        rngs=rngs,
     )
+
+
+def _classification_loss(state: TrainState, params, micro, dropout_rng):
+    """Mean masked softmax-CE over one microbatch, in fp32."""
+    logits = _apply(state, params, micro, dropout_rng)
     labels = micro["labels"]
     valid = micro.get("valid")
     if valid is None:
@@ -60,11 +65,53 @@ def _forward_loss(state: TrainState, params, micro, dropout_rng):
     return loss, logits
 
 
+def _lm_shift_and_mask(micro):
+    """Next-token targets + per-position validity for causal LM batches.
+
+    Position t predicts token t+1. Shift via ``roll`` (not slicing) so every
+    tensor keeps the full [B, S] shape — slicing the sharded sequence dim
+    makes the SPMD partitioner fully rematerialize the logits grad on the
+    pad. The rolled-in last position is masked out, as are pad targets
+    (attention_mask) and padded eval rows (valid).
+    """
+    ids = micro["input_ids"]
+    targets = jnp.roll(ids, -1, axis=1)
+    mask = micro.get("attention_mask")
+    mask = (
+        jnp.ones_like(ids, jnp.float32)
+        if mask is None
+        else jnp.roll(mask, -1, axis=1).astype(jnp.float32)
+    )
+    mask = mask.at[:, -1].set(0.0)
+    valid = micro.get("valid")
+    if valid is not None:
+        mask = mask * valid.astype(jnp.float32)[:, None]
+    return targets, mask
+
+
+def _causal_lm_loss(state: TrainState, params, micro, dropout_rng):
+    """Mean next-token CE per valid target position, in fp32."""
+    logits = _apply(state, params, micro, dropout_rng)
+    targets, mask = _lm_shift_and_mask(micro)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss, logits
+
+
+_LOSS_FNS = {
+    "classification": _classification_loss,
+    "causal_lm": _causal_lm_loss,
+}
+
+
 def make_train_step(
     *,
     grad_accum_steps: int,
     mesh: Optional[Mesh] = None,
     state_shardings=None,
+    objective: str = "classification",
 ) -> Callable:
     """Build the jitted train step.
 
@@ -75,6 +122,8 @@ def make_train_step(
     the per-boundary gradient AllReduce over ICI.
     """
 
+    forward_loss = _LOSS_FNS[objective]
+
     def train_step(state: TrainState, batch):
         base_rng = jax.random.fold_in(state.dropout_rng, state.step)
 
@@ -83,7 +132,7 @@ def make_train_step(
             step_rng = jax.random.fold_in(base_rng, loss_acc[1].astype(jnp.int32))
 
             def loss_fn(p):
-                loss, _ = _forward_loss(state, p, micro, step_rng)
+                loss, _ = forward_loss(state, p, micro, step_rng)
                 return loss
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -120,23 +169,34 @@ def make_train_step(
     )
 
 
-def make_eval_step(*, mesh: Optional[Mesh] = None, state_shardings=None) -> Callable:
-    """Build the jitted eval step → replicated scalar confusion counts.
+def make_eval_step(
+    *,
+    mesh: Optional[Mesh] = None,
+    state_shardings=None,
+    objective: str = "classification",
+) -> Callable:
+    """Build the jitted eval step → replicated scalar counts.
 
-    Returns {"correct", "total", "tp", "fp", "fn"} summed over the (masked)
-    batch; the host-side ``MetricAccumulator`` folds batches together. The
-    positive class for binary F1 is label 1 (GLUE/MRPC convention:
-    "equivalent" == 1).
+    classification: {"correct", "total", "tp", "fp", "fn"} summed over the
+    (masked) batch — host-side ``MetricAccumulator`` folds batches; positive
+    class for binary F1 is label 1 (GLUE/MRPC convention).
+    causal_lm: {"nll_sum", "token_count", "token_correct"} — folds into
+    ``LMMetricAccumulator`` (eval loss / perplexity / token accuracy).
     """
 
+    def lm_eval_step(state: TrainState, batch):
+        logits = _apply(state, state.params, batch, None).astype(jnp.float32)
+        targets, mask = _lm_shift_and_mask(batch)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        preds = jnp.argmax(logits, axis=-1)
+        return {
+            "nll_sum": (ce * mask).sum(),
+            "token_count": mask.sum(),
+            "token_correct": ((preds == targets) * mask).sum(),
+        }
+
     def eval_step(state: TrainState, batch):
-        logits = state.apply_fn(
-            {"params": state.params},
-            batch["input_ids"],
-            batch.get("attention_mask"),
-            batch.get("token_type_ids"),
-            deterministic=True,
-        )
+        logits = _apply(state, state.params, batch, None)
         preds = jnp.argmax(logits.astype(jnp.float32), axis=-1)
         labels = batch["labels"]
         valid = batch.get("valid")
@@ -154,12 +214,18 @@ def make_eval_step(*, mesh: Optional[Mesh] = None, state_shardings=None) -> Call
             "fn": ((1.0 - pos_pred) * pos_label).sum(),
         }
 
+    fn = lm_eval_step if objective == "causal_lm" else eval_step
+    keys = (
+        ("nll_sum", "token_count", "token_correct")
+        if objective == "causal_lm"
+        else ("correct", "total", "tp", "fp", "fn")
+    )
     if mesh is None:
-        return jax.jit(eval_step)
+        return jax.jit(fn)
     batch_sharding = NamedSharding(mesh, P(BATCH_AXES))
     replicated = NamedSharding(mesh, P())
     return jax.jit(
-        eval_step,
+        fn,
         in_shardings=(state_shardings, batch_sharding),
-        out_shardings={k: replicated for k in ("correct", "total", "tp", "fp", "fn")},
+        out_shardings={k: replicated for k in keys},
     )

@@ -137,10 +137,16 @@ _MODEL_PRESETS: dict[str, dict[str, Any]] = {
         intermediate_size=4096, max_position_embeddings=1024,
         type_vocab_size=0, causal=True, layer_norm_eps=1e-5,
     ),
-    # tiny config for tests (no reference counterpart; SURVEY.md §4 parity tests)
+    # tiny configs for tests/smoke runs (no reference counterpart; SURVEY.md
+    # §4 parity tests)
     "tiny": dict(
         vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
         intermediate_size=128, max_position_embeddings=128,
+    ),
+    "gpt2-tiny": dict(
+        vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=128,
+        type_vocab_size=0, causal=True, layer_norm_eps=1e-5,
     ),
 }
 

@@ -1,0 +1,110 @@
+"""GPT-2 causal-LM family: model semantics, LM objective, FSDP trainer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+from pytorch_distributed_training_tpu.utils.config import (
+    MeshConfig,
+    TrainConfig,
+    model_preset,
+)
+
+
+def tiny_lm(**kw):
+    base = dict(
+        compute_dtype="float32", causal=True, type_vocab_size=0,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    base.update(kw)
+    return model_preset("tiny", **base)
+
+
+def test_gpt2_forward_shape_and_tied_head():
+    cfg = tiny_lm()
+    model = GPT2LMModel(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # tied head: no separate lm_head kernel in the tree
+    assert "lm_head" not in params and "wte" in params
+
+
+def test_gpt2_is_causal():
+    """Changing a future token must not change past logits."""
+    cfg = tiny_lm()
+    model = GPT2LMModel(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 12)), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    out1 = model.apply({"params": params}, ids)
+    ids2 = ids.at[0, 8].set((int(ids[0, 8]) + 7) % cfg.vocab_size)
+    out2 = model.apply({"params": params}, ids2)
+    np.testing.assert_allclose(
+        np.asarray(out1[0, :8]), np.asarray(out2[0, :8]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(out1[0, 8:]), np.asarray(out2[0, 8:]))
+
+
+def test_lm_loss_matches_manual():
+    from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
+    from pytorch_distributed_training_tpu.train.state import create_train_state
+    from pytorch_distributed_training_tpu.train.step import make_eval_step
+
+    cfg = tiny_lm()
+    model = GPT2LMModel(cfg)
+    rng = np.random.default_rng(2)
+    ids = np.asarray(rng.integers(2, 200, (4, 16)), np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.ones((4, 16), jnp.int32),
+    }
+    tx, _ = adamw_with_schedule(TrainConfig(), 10)
+    state = create_train_state(model, tx, jax.random.key(0), batch)
+    counts = make_eval_step(objective="causal_lm")(state, batch)
+
+    logits = np.asarray(
+        model.apply({"params": state.params}, batch["input_ids"])
+    )
+    # manual shifted NLL
+    tgt = ids[:, 1:]
+    lp = logits[:, :-1] - jax.scipy.special.logsumexp(
+        logits[:, :-1], axis=-1, keepdims=True
+    )
+    nll = -np.take_along_axis(np.asarray(lp), tgt[..., None], axis=-1)
+    np.testing.assert_allclose(
+        float(counts["nll_sum"]), nll.sum(), rtol=1e-4
+    )
+    assert float(counts["token_count"]) == 4 * 15
+
+
+def test_lm_trainer_learns_markov_chain(eight_devices):
+    """End-to-end: GPT-2-tiny + FSDP mesh on the synthetic Markov corpus.
+    The chain has ≈4 plausible next tokens per context (entropy ≈ ln4 with
+    dirichlet skew); a model that learns it beats the 256-token uniform
+    floor (ln256 ≈ 5.5) decisively."""
+    from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+    from pytorch_distributed_training_tpu.train.loop import Trainer
+
+    cfg = tiny_lm(scan_layers=True)
+    tcfg = TrainConfig(
+        num_epochs=2, global_batch_size=32, micro_batch_size=16,
+        eval_batch_size=32, learning_rate=3e-3, warmup_steps=10,
+        log_every=0, bf16=False, max_seq_length=32,
+        train_size=1024, eval_size=128,
+    )
+    trainer = Trainer(
+        cfg, tcfg, MeshConfig(data=2, fsdp=4),
+        ShardingPolicy(fsdp=True, fsdp_min_size=128),
+        task="lm",
+    )
+    history = trainer.run()
+    assert trainer.objective == "causal_lm"
+    rec = history[-1]
+    assert {"eval_loss", "perplexity", "token_accuracy"} <= set(rec)
+    assert rec["eval_loss"] < 4.0  # well under the uniform-over-256 floor
+    assert history[-1]["eval_loss"] < history[0]["eval_loss"] + 1e-6
