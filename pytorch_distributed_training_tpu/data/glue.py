@@ -90,16 +90,17 @@ def load_task_arrays(
         return data, 2
 
     if task == "lm":
-        # One corpus from one chain (same seed), split into disjoint rows:
-        # eval measures how well the model learned the shared transition
-        # table on rows it never saw.
+        # Both splits sample the SAME chain (transition table from ``seed``)
+        # via independent row streams: eval measures how well the model
+        # learned the shared table on rows it never saw, and each split is
+        # generated directly at its own size (no discarded corpus half).
         n_train, n_eval = synthetic_sizes
+        n = n_train if split == "train" else n_eval
         data = synthetic.synthetic_lm_task(
-            n_train + n_eval, max_length=max_length, vocab_size=vocab_size,
-            seed=seed,
+            n, max_length=max_length, vocab_size=vocab_size,
+            seed=seed, row_seed=seed + (1 if split == "train" else 2),
         )
-        sl = slice(0, n_train) if split == "train" else slice(n_train, None)
-        return {k: v[sl] for k, v in data.items()}, 0
+        return data, 0
 
     if task not in TASKS:
         raise KeyError(f"unknown task {task!r}; have {sorted(TASKS)}")

@@ -31,6 +31,7 @@ def synthetic_lm_task(
     vocab_size: int = 50257,
     seed: int = 42,
     order: int = 1,
+    row_seed: int | None = None,
 ) -> dict[str, np.ndarray]:
     """Learnable causal-LM corpus: a fixed random order-``order`` Markov
     chain over a small token alphabet, embedded in the full vocab.
@@ -40,6 +41,10 @@ def synthetic_lm_task(
     benchmarks see real learning dynamics (the LM analogue of the
     paraphrase-shaped task above). Dense rows — no padding — matching
     packed-sequence LM training.
+
+    The transition table depends only on ``seed``; ``row_seed`` (when given)
+    seeds an independent stream for the row sampling, so disjoint splits of
+    the same chain can each be generated directly at their own size.
     """
     rng = np.random.default_rng(seed)
     alphabet = 256  # tokens 2..258: leave 0/1 for pad/eos conventions
@@ -47,6 +52,8 @@ def synthetic_lm_task(
     table = rng.dirichlet(np.full(4, 0.5), size=alphabet**order)
     cum = table.cumsum(axis=1)
     prefs = rng.integers(0, alphabet, size=(alphabet**order, 4))
+    if row_seed is not None:
+        rng = np.random.default_rng(row_seed)
 
     ids = np.empty((n_examples, max_length), np.int64)
     ids[:, :order] = rng.integers(0, alphabet, size=(n_examples, order))

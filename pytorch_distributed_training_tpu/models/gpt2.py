@@ -57,13 +57,22 @@ class GPT2Block(nn.Module):
         return x + h
 
 
+def _gpt2_layer_cls(cfg: ModelConfig):
+    """GPT2Block, remat-wrapped when configured — same nn.remat/static_argnums
+    contract as bert._layer_cls (GPT2Block.__call__ shares BertLayer's
+    signature, with ``deterministic`` at position 3)."""
+    if cfg.remat:
+        return nn.remat(GPT2Block, static_argnums=(3,))
+    return GPT2Block
+
+
 class _GPT2ScanBlock(nn.Module):
     config: ModelConfig
     deterministic: bool
 
     @nn.compact
     def __call__(self, x, attention_bias):
-        x = GPT2Block(self.config, name="block")(
+        x = _gpt2_layer_cls(self.config)(self.config, name="block")(
             x, attention_bias, self.deterministic
         )
         return x, None
@@ -123,7 +132,9 @@ class GPT2LMModel(nn.Module):
             x, _ = scan(cfg, deterministic, name="layers_scan")(x, bias)
         else:
             for i in range(cfg.num_layers):
-                x = GPT2Block(cfg, name=f"block_{i}")(x, bias, deterministic)
+                x = _gpt2_layer_cls(cfg)(cfg, name=f"block_{i}")(
+                    x, bias, deterministic
+                )
 
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=jnp.float32,

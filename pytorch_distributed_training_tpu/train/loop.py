@@ -79,17 +79,27 @@ class Trainer:
                 f"decoder preset (gpt2-*) with --task lm, an encoder preset "
                 f"with classification tasks"
             )
+        from pytorch_distributed_training_tpu.data import synthetic
+
+        # Synthetic tasks generate rows at requested size directly (hub tasks
+        # still load the full split and get truncated below).
+        sizes = (
+            train_config.train_size or synthetic.MRPC_TRAIN_SIZE,
+            train_config.eval_size or synthetic.MRPC_EVAL_SIZE,
+        )
         train_data, num_labels = load_task_arrays(
             task, "train",
             max_length=train_config.max_seq_length,
             vocab_size=model_config.vocab_size,
             seed=train_config.seed,
+            synthetic_sizes=sizes,
         )
         eval_data, _ = load_task_arrays(
             task, "validation",
             max_length=train_config.max_seq_length,
             vocab_size=model_config.vocab_size,
             seed=train_config.seed,
+            synthetic_sizes=sizes,
         )
         if train_config.train_size:
             train_data = {

@@ -214,12 +214,15 @@ def make_eval_step(
             "fn": ((1.0 - pos_pred) * pos_label).sum(),
         }
 
-    fn = lm_eval_step if objective == "causal_lm" else eval_step
-    keys = (
-        ("nll_sum", "token_count", "token_correct")
-        if objective == "causal_lm"
-        else ("correct", "total", "tp", "fp", "fn")
+    from pytorch_distributed_training_tpu.train.metrics import (
+        LMMetricAccumulator,
+        MetricAccumulator,
     )
+
+    if objective == "causal_lm":
+        fn, keys = lm_eval_step, LMMetricAccumulator.FIELDS
+    else:
+        fn, keys = eval_step, MetricAccumulator.FIELDS
     if mesh is None:
         return jax.jit(fn)
     batch_sharding = NamedSharding(mesh, P(BATCH_AXES))
