@@ -80,7 +80,9 @@ def run_bench(
         "attention_mask": jnp.ones((2, seq_len), jnp.int32),
         "token_type_ids": jnp.zeros((2, seq_len), jnp.int32),
     }
-    state = create_train_state(model, tx, jax.random.key(42), example)
+    state = create_train_state(
+        model, tx, jax.random.key(42, impl=tcfg.prng_impl), example
+    )
     shardings = state_shardings(state, ShardingPolicy(), mesh)
     state = shard_state(state, shardings)
     train_step = make_train_step(

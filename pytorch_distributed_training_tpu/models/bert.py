@@ -123,7 +123,7 @@ class BertLayer(nn.Module):
         x = x.astype(_dtype(cfg))
 
         h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(x)
-        h = nn.gelu(h, approximate=False)
+        h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
         h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(**ln, name="mlp_norm")(x + h)

@@ -8,6 +8,14 @@ restart-from-checkpoint: each save captures params + optimizer state + step +
 the dropout RNG key, written shard-by-shard from every host (orbax OCDBT),
 and restore re-places each leaf on its mesh sharding — so a resumed run
 continues the exact optimizer trajectory on any compatible mesh.
+
+The dropout key is stored as raw ``jax.random.key_data`` words in a
+fixed-size uint32 buffer ``[n_words, *words, pad...]``: the container shape
+is then independent of both jax's extended-dtype plumbing and the PRNG impl,
+so a checkpoint written under one impl restores under another — the key
+stream itself can't carry across impls (different word sizes), so on an impl
+mismatch restore keeps the fresh state's key and logs a warning instead of
+crashing mid-resume.
 """
 
 from __future__ import annotations
@@ -22,10 +30,39 @@ from pytorch_distributed_training_tpu.train.state import TrainState
 from pytorch_distributed_training_tpu.utils.logging import log0
 
 _SAVEABLE = ("step", "params", "opt_state", "dropout_rng")
+_RNG_BUF_WORDS = 8  # fits every jax key impl (threefry 2, rbg/unsafe_rbg 4)
 
 
 def _saveable(state: TrainState) -> dict:
-    return {k: getattr(state, k) for k in _SAVEABLE}
+    import jax.numpy as jnp
+
+    d = {k: getattr(state, k) for k in _SAVEABLE}
+    words = jax.random.key_data(state.dropout_rng).ravel().astype(jnp.uint32)
+    buf = jnp.zeros((_RNG_BUF_WORDS + 1,), jnp.uint32)
+    buf = buf.at[0].set(words.size).at[1 : 1 + words.size].set(words)
+    d["dropout_rng"] = buf
+    return d
+
+
+def _merge_restored(state: TrainState, restored: dict) -> TrainState:
+    """Rebuild the typed dropout key from the restored word buffer; on an
+    impl (word-count) mismatch keep the fresh key — the optimizer trajectory
+    lives in params/opt_state/step, the dropout stream is not worth a failed
+    resume."""
+    cur_data = jax.random.key_data(state.dropout_rng)
+    buf = jax.device_get(restored.pop("dropout_rng"))
+    n = int(buf[0])
+    if n == cur_data.size:
+        restored["dropout_rng"] = jax.random.wrap_key_data(
+            buf[1 : 1 + n].reshape(cur_data.shape).astype(cur_data.dtype),
+            impl=jax.random.key_impl(state.dropout_rng),
+        )
+    else:
+        log0(
+            f"checkpoint dropout_rng has {n} key words but the configured"
+            f" prng_impl uses {cur_data.size}; keeping the fresh key"
+        )
+    return state.replace(**restored)
 
 
 class Checkpointer:
@@ -60,10 +97,12 @@ class Checkpointer:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _saveable(state))
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, _saveable(state)
+        )
         restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
         log0(f"checkpoint restored: {self.directory}/{step}")
-        return state.replace(**restored)
+        return _merge_restored(state, dict(restored))
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
@@ -101,7 +140,9 @@ def restore_checkpoint(
         step = mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _saveable(state))
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, _saveable(state)
+        )
         restored = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
     log0(f"checkpoint restored: {directory}/{step}")
-    return state.replace(**restored)
+    return _merge_restored(state, dict(restored))

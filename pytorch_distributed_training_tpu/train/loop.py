@@ -148,7 +148,10 @@ class Trainer:
             ),
         }
         state = create_train_state(
-            self.model, tx, jax.random.key(train_config.seed), example
+            self.model,
+            tx,
+            jax.random.key(train_config.seed, impl=train_config.prng_impl),
+            example
         )
         if hf_checkpoint is not None:
             from pytorch_distributed_training_tpu.models.hf_loader import (

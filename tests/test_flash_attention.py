@@ -1,0 +1,124 @@
+"""Flash-attention kernel parity tests (interpret mode on the CPU mesh).
+
+The kernel's contract is bit-level agreement with the reference einsum
+attention (ops/attention.py) on everything except dropout, whose keep mask
+comes from the in-kernel TPU PRNG. Dropout correctness is covered by a
+finite-difference check — valid because the kernel PRNG is deterministic in
+(seed, block ids), so f is a fixed function of its inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_training_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_bias,
+    reference_attention,
+)
+from pytorch_distributed_training_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_base,
+)
+
+
+def _qkv(batch=2, seq=32, heads=2, head_dim=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(batch, seq, heads, head_dim)), dtype
+    )
+    return mk(), mk(), mk()
+
+
+def _padding_mask(batch=2, seq=32, valid_lens=(32, 17)):
+    mask = np.zeros((batch, seq), np.int32)
+    for i, n in enumerate(valid_lens):
+        mask[i, :n] = 1
+    return jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_fwd(causal):
+    q, k, v = _qkv()
+    bias = make_attention_bias(_padding_mask())
+    with pltpu.force_tpu_interpret_mode():
+        out = flash_attention(q, k, v, bias, causal=causal)
+    ref = reference_attention(q, k, v, bias, causal=causal)
+    # padded key rows produce garbage in padded QUERY rows of ref too; compare
+    # only rows the mask marks valid (the model multiplies them out anyway)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[1, :17]), np.asarray(ref[1, :17]), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_grad(causal):
+    q, k, v = _qkv(seed=1)
+    bias = make_attention_bias(_padding_mask())
+    cot = jnp.asarray(
+        np.random.default_rng(2).normal(size=q.shape), jnp.float32
+    )
+    # zero cotangent on padded query rows: their grads are masked downstream
+    cot = cot * _padding_mask()[:, :, None, None]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, bias, causal=causal) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            reference_attention(q, k, v, bias, causal=causal) * cot
+        )
+
+    with pltpu.force_tpu_interpret_mode():
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"d{name} mismatch (causal={causal})",
+        )
+
+
+def test_flash_dropout_finite_difference():
+    """Custom VJP agrees with central differences under in-kernel dropout."""
+    q, k, v = _qkv(batch=1, seq=16, heads=1, head_dim=8, seed=3)
+    bias = jnp.zeros((1, 1, 1, 16), jnp.float32)
+    seed = jnp.asarray([7], jnp.int32)
+    cot = jnp.asarray(
+        np.random.default_rng(4).normal(size=q.shape), jnp.float32
+    )
+
+    def f(q):
+        out = flash_attention_base(
+            q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+            bias, seed, dropout_rate=0.5, causal=False,
+            block_q=16, block_k=16,
+        )
+        return jnp.sum(out * cot.transpose(0, 2, 1, 3))
+
+    qt = q.transpose(0, 2, 1, 3)
+    with pltpu.force_tpu_interpret_mode():
+        g = jax.grad(f)(qt)
+        rng = np.random.default_rng(5)
+        for _ in range(3):
+            d = jnp.asarray(rng.normal(size=qt.shape), jnp.float32)
+            eps = 1e-3
+            fd = (f(qt + eps * d) - f(qt - eps * d)) / (2 * eps)
+            an = jnp.sum(g * d)
+            np.testing.assert_allclose(
+                float(fd), float(an), rtol=2e-2, atol=1e-3
+            )
+
+
+def test_flash_dispatch_and_fallback():
+    q, k, v = _qkv(seq=24)  # 24 % block fine (block=min(128,24)=24)
+    # per-head bias → must fall back to reference, not mis-mask
+    bias = jnp.zeros((2, 2, 24, 24), jnp.float32)
+    out = dot_product_attention(q, k, v, bias, impl="flash")
+    ref = reference_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)

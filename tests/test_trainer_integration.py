@@ -110,3 +110,40 @@ def test_checkpoint_save_restore_resume(trained, tmp_path):
         [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(restored.params)]
     )
     np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_restore_across_prng_impl(trained, tmp_path):
+    """A checkpoint saved under one dropout-PRNG impl restores under another:
+    params/opt_state/step carry over, the key falls back to the fresh one
+    with a warning instead of a shape-mismatch crash (the key stream itself
+    cannot carry across impls — different word sizes)."""
+    import jax
+
+    from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+
+    trainer, _ = trained
+    d = str(tmp_path / "ckpt_impl")
+    ckpt.save_checkpoint(d, trainer.state)
+
+    other_impl = (
+        "threefry2x32"
+        if jax.random.key_data(trainer.state.dropout_rng).shape[-1] != 2
+        else "rbg"
+    )
+    fresh = small_trainer(prng_impl=other_impl)
+    restored = ckpt.restore_checkpoint(d, fresh.state)
+    assert int(jax.device_get(restored.step)) == int(
+        jax.device_get(trainer.state.step)
+    )
+    a = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(trainer.state.params)]
+    )
+    b = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(restored.params)]
+    )
+    np.testing.assert_array_equal(a, b)
+    # the fresh impl's key survives untouched
+    assert (
+        jax.random.key_data(restored.dropout_rng).shape
+        == jax.random.key_data(fresh.state.dropout_rng).shape
+    )
