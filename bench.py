@@ -67,7 +67,14 @@ def run_bench(
     n_chips = jax.device_count()
     mesh = build_mesh()
     mcfg = model_preset(model_name)
-    model = BertForSequenceClassification(mcfg)
+    if mcfg.causal:
+        from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+
+        model = GPT2LMModel(mcfg)
+        objective = "causal_lm"
+    else:
+        model = BertForSequenceClassification(mcfg)
+        objective = "classification"
     tcfg = TrainConfig(
         global_batch_size=global_batch,
         micro_batch_size=micro_batch,
@@ -89,14 +96,24 @@ def run_bench(
         grad_accum_steps=tcfg.grad_accum_steps,
         mesh=mesh,
         state_shardings=shardings,
+        objective=objective,
     )
 
     # A few distinct batches, cycled, with per-step device placement included
     # in the timing (as a real input pipeline would pay it).
     n_examples = global_batch * 4
-    data = synthetic_pair_task(
-        n_examples, max_length=seq_len, vocab_size=mcfg.vocab_size, seed=42
-    )
+    if mcfg.causal:
+        from pytorch_distributed_training_tpu.data.synthetic import (
+            synthetic_lm_task,
+        )
+
+        data = synthetic_lm_task(
+            n_examples, max_length=seq_len, vocab_size=mcfg.vocab_size, seed=42
+        )
+    else:
+        data = synthetic_pair_task(
+            n_examples, max_length=seq_len, vocab_size=mcfg.vocab_size, seed=42
+        )
     loader = ShardedLoader(
         data, mesh,
         global_batch_size=global_batch,
@@ -127,8 +144,9 @@ def run_bench(
 
     sps = global_batch * timed_steps / elapsed
     sps_chip = sps / n_chips
+    recipe = "causal-LM" if mcfg.causal else "MRPC-recipe"
     return {
-        "metric": f"{model_name} MRPC-recipe fine-tune throughput (seq {seq_len}, global batch {global_batch}, bf16)",
+        "metric": f"{model_name} {recipe} fine-tune throughput (seq {seq_len}, global batch {global_batch}, bf16)",
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 4),

@@ -40,12 +40,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "roberta-large, gpt2-medium, tiny)")
     p.add_argument("--task", default="auto",
                    help="mrpc | mnli | synthetic | auto (mrpc w/ fallback)")
-    p.add_argument("--attention", default="reference",
-                   help="attention impl: reference | flash | ring")
+    p.add_argument("--attention", default=None,
+                   help="attention impl: reference | flash | ring "
+                        "(default: preset's; ring when --mesh-seq > 1)")
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction,
                    default=False, help="shard params/opt state over fsdp axis")
     p.add_argument("--mesh-data", type=int, default=-1)
     p.add_argument("--mesh-fsdp", type=int, default=1)
+    p.add_argument("--mesh-seq", type=int, default=1,
+                   help="context-parallel degree (ring attention)")
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -54,12 +57,16 @@ def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     tcfg = dataclass_from_args(TrainConfig, args)
     # bf16 flag maps onto the model dtype policy
-    mcfg = model_preset(
-        args.model,
+    attention = args.attention or ("ring" if args.mesh_seq > 1 else None)
+    overrides = dict(
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
-        attention_impl=args.attention,
     )
-    mesh_cfg = MeshConfig(data=args.mesh_data, fsdp=args.mesh_fsdp)
+    if attention:
+        overrides["attention_impl"] = attention
+    mcfg = model_preset(args.model, **overrides)
+    mesh_cfg = MeshConfig(
+        data=args.mesh_data, fsdp=args.mesh_fsdp, seq=args.mesh_seq
+    )
     policy = ShardingPolicy(fsdp=args.fsdp)
     trainer = Trainer(mcfg, tcfg, mesh_cfg, policy, task=args.task)
     return trainer.run()

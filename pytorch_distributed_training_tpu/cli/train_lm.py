@@ -34,7 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="gpt2-medium")
     p.add_argument("--task", default="lm",
                    help="lm (synthetic causal-LM corpus)")
-    p.add_argument("--attention", default="reference")
+    p.add_argument("--attention", default=None)
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--tp", action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--scan-layers", action=argparse.BooleanOptionalAction,
@@ -42,6 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-data", type=int, default=1)
     p.add_argument("--mesh-fsdp", type=int, default=-1)
     p.add_argument("--mesh-model", type=int, default=1)
+    p.add_argument("--mesh-seq", type=int, default=1,
+                   help="context-parallel degree (ring attention)")
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -49,19 +51,22 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     tcfg = dataclass_from_args(TrainConfig, args)
-    mcfg = model_preset(
-        args.model,
+    attention = args.attention or ("ring" if args.mesh_seq > 1 else None)
+    overrides = dict(
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
-        attention_impl=args.attention,
         scan_layers=args.scan_layers,
     )
+    if attention:
+        overrides["attention_impl"] = attention
+    mcfg = model_preset(args.model, **overrides)
     if not mcfg.causal:
         raise SystemExit(
             f"--model {args.model} is not a causal/decoder preset; "
             f"use gpt2-medium (or set causal=True on a custom config)"
         )
     mesh_cfg = MeshConfig(
-        data=args.mesh_data, fsdp=args.mesh_fsdp, model=args.mesh_model
+        data=args.mesh_data, fsdp=args.mesh_fsdp, model=args.mesh_model,
+        seq=args.mesh_seq,
     )
     policy = ShardingPolicy(fsdp=args.fsdp, tp=args.tp)
     trainer = Trainer(mcfg, tcfg, mesh_cfg, policy, task=args.task)

@@ -50,12 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="branch = TriBert-style ensemble over the model axis; "
                         "stage = ConcatBert-style layer split over the stage axis")
     p.add_argument("--n-branches", type=int, default=3)
-    p.add_argument("--attention", default="reference")
+    p.add_argument("--attention", default=None)
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--mesh-data", type=int, default=-1)
     p.add_argument("--mesh-fsdp", type=int, default=1)
     p.add_argument("--mesh-stage", type=int, default=1)
     p.add_argument("--mesh-model", type=int, default=1)
+    p.add_argument("--mesh-seq", type=int, default=1,
+                   help="context-parallel degree (ring attention)")
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -63,15 +65,17 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     tcfg = dataclass_from_args(TrainConfig, args)
-    mcfg = model_preset(
-        args.model,
+    attention = args.attention or ("ring" if args.mesh_seq > 1 else None)
+    overrides = dict(
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
-        attention_impl=args.attention,
         scan_layers=args.mp_mode == "stage",
     )
+    if attention:
+        overrides["attention_impl"] = attention
+    mcfg = model_preset(args.model, **overrides)
     mesh_cfg = MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp,
-        stage=args.mesh_stage, model=args.mesh_model,
+        stage=args.mesh_stage, model=args.mesh_model, seq=args.mesh_seq,
     )
     if args.mp_mode == "branch":
         if args.mesh_model > 1 and args.n_branches % args.mesh_model:

@@ -16,7 +16,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
-from pytorch_distributed_training_tpu.comms.mesh import batch_pspec
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_tpu.comms.mesh import AXIS_SEQ, batch_pspec
+
+
+def _leaf_spec(mesh: Mesh, base: P, ndim: int) -> P:
+    """Extend the batch spec with the ``seq`` axis for sequence-bearing
+    leaves (ids/masks: [..., batch, S]), leaving rank-(len(base)) leaves
+    (labels) untouched. No-op on meshes without context parallelism."""
+    if mesh.shape.get(AXIS_SEQ, 1) > 1 and ndim > len(base):
+        return P(*base, AXIS_SEQ)
+    return base
 
 
 def make_global_batch(mesh: Mesh, local_batch, pspec=None):
@@ -28,9 +39,12 @@ def make_global_batch(mesh: Mesh, local_batch, pspec=None):
 
     ``pspec`` defaults to sharding dim 0 over (data, fsdp); train batches
     laid out [grad_accum, micro_batch, ...] pass ``P(None, BATCH_AXES)`` so
-    the accumulation axis stays whole and the micro-batch dim shards.
+    the accumulation axis stays whole and the micro-batch dim shards. On a
+    mesh with a non-trivial ``seq`` axis, the sequence dim of token-bearing
+    leaves additionally shards over it (context parallelism — ring attention
+    then never needs the full sequence on one device).
     """
-    sharding = NamedSharding(mesh, pspec if pspec is not None else batch_pspec())
+    base = pspec if pspec is not None else batch_pspec()
 
     def _make(x: np.ndarray):
         x = np.asarray(x)
@@ -39,6 +53,7 @@ def make_global_batch(mesh: Mesh, local_batch, pspec=None):
                 "make_global_batch leaves must have a leading batch dim; "
                 "got a 0-d scalar (promote it with x[None] first)"
             )
+        sharding = NamedSharding(mesh, _leaf_spec(mesh, base, x.ndim))
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(_make, local_batch)

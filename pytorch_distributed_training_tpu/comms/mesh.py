@@ -28,6 +28,7 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_STAGE = "stage"
 AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
 AXIS_NAMES = MeshConfig.AXIS_NAMES
 
 # Batch dimension shards over both flavors of data parallelism.
@@ -38,6 +39,17 @@ BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
 # pipeline places batches with this spec and the train step declares it as
 # in_sharding — single source of truth for the layout contract.
 TRAIN_BATCH_PSPEC = P(None, BATCH_AXES)
+
+
+# The most recently built mesh. Ops that must open an explicit-SPMD region
+# inside model code (ring attention's shard_map) need the concrete Mesh
+# object, which flax module calls can't thread through their signatures —
+# build_mesh records it here and ``current_mesh()`` hands it back.
+_CURRENT_MESH: Mesh | None = None
+
+
+def current_mesh() -> Mesh | None:
+    return _CURRENT_MESH
 
 
 def build_mesh(
@@ -65,7 +77,9 @@ def build_mesh(
         # single-chip tunnel); a plain reshape is always valid, just not
         # locality-optimized.
         dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, AXIS_NAMES)
+    global _CURRENT_MESH
+    _CURRENT_MESH = Mesh(dev_array, AXIS_NAMES)
+    return _CURRENT_MESH
 
 
 def batch_pspec(extra_dims: int = 0) -> P:

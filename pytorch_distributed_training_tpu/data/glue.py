@@ -107,9 +107,28 @@ def load_task_arrays(
     ds_args, field_a, field_b, num_labels = TASKS[task]
     import datasets  # deferred: optional dependency
 
+    hub_split = split
     if task == "mnli" and split == "validation":
-        split = "validation_matched"
-    ds = datasets.load_dataset(*ds_args, split=split)
+        hub_split = "validation_matched"
+    try:
+        ds = datasets.load_dataset(*ds_args, split=hub_split)
+    except (ConnectionError, TimeoutError, OSError) as e:
+        # Connectivity/cache failures only (this zero-egress image raises
+        # ConnectionError) — anything else (bad split, broken install) must
+        # propagate: an explicitly requested task silently swapping to
+        # synthetic data would report metrics that look real but aren't.
+        log0(
+            f"glue/{task} unavailable ({type(e).__name__}); falling back to "
+            f"the synthetic pair task with num_labels={num_labels}"
+        )
+        n_train, n_eval = synthetic_sizes
+        n = n_train if split == "train" else n_eval
+        data = synthetic.synthetic_pair_task(
+            n, max_length=max_length, vocab_size=vocab_size,
+            num_labels=num_labels,
+            seed=seed if split == "train" else seed + 1,
+        )
+        return data, num_labels
     tokenizer = make_tokenizer(vocab_path, vocab_size)
     arrays = encode_pairs(
         tokenizer, ds[field_a], ds[field_b], max_length=max_length

@@ -1,0 +1,181 @@
+"""Prefetching train loader backed by the native C++ batch assembler.
+
+Same iteration contract as ``pipeline.ShardedLoader`` (train mode): yields
+[grad_accum, local_micro, ...] batches placed as global sharded arrays. The
+difference is WHO assembles: a C++ worker pool (native/src/batcher.cpp)
+gathers permuted rows into a ring of reusable buffers ahead of consumption,
+overlapping host assembly with device compute — the role torch's DataLoader
+workers play in the reference's stack (reference test_data_parallelism.py:
+102-107).
+
+Cross-host consistency AND engine interchangeability: the epoch permutation
+is computed here with ``np.random.default_rng((seed, epoch)).permutation`` —
+byte-identical to ``pipeline.ShardedLoader``'s order — and handed to the C++
+side. Every process assembles slices of the SAME global batch (the property
+that keeps collectives from deadlocking, SURVEY.md §7 hard parts), and a
+run may checkpoint under one engine and resume under the other with the
+exact data trajectory preserved.
+
+Slot lifetime: a yielded batch's host buffers live in a ring slot. The slot
+is released two iterations later, after ``jax.block_until_ready`` on the
+batch that lived there confirms its H2D transfer finished (normally a no-op
+by then, keeping the release off the critical path). Integer datasets only
+(the GLUE/LM contract); eval mode is served by the Python loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+from pytorch_distributed_training_tpu.comms.mesh import (
+    TRAIN_BATCH_PSPEC,
+    dp_degree,
+)
+from pytorch_distributed_training_tpu.native import load_batcher_lib
+
+_RING_SLOTS = 4
+_WORKERS = 2
+
+
+class NativeShardedLoader:
+    """Drop-in for ``ShardedLoader(train=True)`` with C++ prefetch."""
+
+    def __init__(
+        self,
+        data: dict[str, np.ndarray],
+        mesh: Mesh,
+        *,
+        global_batch_size: int,
+        grad_accum_steps: int = 1,
+        seed: int = 42,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        lib = load_batcher_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native batcher unavailable (no C++ toolchain?) — use "
+                "pipeline.ShardedLoader"
+            )
+        self._lib = lib
+        self.mesh = mesh
+        self.seed = seed
+        self.global_batch = global_batch_size
+        self.accum = grad_accum_steps
+        self.train = True
+
+        self.pidx = jax.process_index() if process_index is None else process_index
+        self.pcount = jax.process_count() if process_count is None else process_count
+        if global_batch_size % (self.accum * self.pcount):
+            raise ValueError(
+                f"global batch {global_batch_size} must divide by "
+                f"accum*processes ({self.accum}*{self.pcount})"
+            )
+        dp = dp_degree(mesh)
+        micro_global = global_batch_size // self.accum
+        if micro_global % dp:
+            raise ValueError(
+                f"micro batch {micro_global} must divide by data-parallel "
+                f"degree {dp}"
+            )
+        micro_local = micro_global // self.pcount
+
+        # int32, C-contiguous copies the C++ side can point at; keys sorted
+        # for a deterministic array order across hosts.
+        for k, v in data.items():
+            if not np.issubdtype(np.asarray(v).dtype, np.integer):
+                raise TypeError(
+                    f"native loader serves integer datasets only; {k!r} is "
+                    f"{np.asarray(v).dtype} — use pipeline.ShardedLoader"
+                )
+        self._keys = sorted(data)
+        self._arrays = [
+            np.ascontiguousarray(np.asarray(data[k], np.int32))
+            for k in self._keys
+        ]
+        self.n = len(self._arrays[0])
+        self._row_elems = [
+            int(np.prod(a.shape[1:], dtype=np.int64)) for a in self._arrays
+        ]
+        self._row_shapes = [a.shape[1:] for a in self._arrays]
+
+        arr_ptrs = (ctypes.c_void_p * len(self._arrays))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays]
+        )
+        row_elems = (ctypes.c_int64 * len(self._arrays))(*self._row_elems)
+        self._handle = lib.batcher_create(
+            arr_ptrs,
+            row_elems,
+            len(self._arrays),
+            self.n,
+            self.accum,
+            micro_global,
+            micro_local,
+            self.pidx * micro_local,
+            _RING_SLOTS,
+            _WORKERS,
+        )
+        self._micro_local = micro_local
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.n // self.global_batch
+
+    def epoch(self, epoch_index: int = 0) -> Iterator[dict]:
+        lib = self._lib
+        # SAME permutation as pipeline.ShardedLoader._train_epoch — the two
+        # engines must be interchangeable mid-run (mid-epoch resume).
+        perm = np.ascontiguousarray(
+            np.random.default_rng((self.seed, epoch_index)).permutation(self.n),
+            dtype=np.int64,
+        )
+        n_steps = lib.batcher_start_epoch(
+            self._handle, perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        )
+        out_ptrs = (ctypes.c_void_p * len(self._arrays))()
+        held: list[tuple[int, dict]] = []
+
+        def release(slot, placed):
+            # the slot's buffers may be overwritten once released: make sure
+            # the device transfer that read them has completed
+            jax.block_until_ready(placed)
+            lib.batcher_release(self._handle, slot)
+
+        try:
+            for _ in range(n_steps):
+                slot = lib.batcher_next(self._handle, out_ptrs)
+                if slot < 0:
+                    break
+                batch = {}
+                for i, k in enumerate(self._keys):
+                    shape = (self.accum, self._micro_local, *self._row_shapes[i])
+                    n_el = self.accum * self._micro_local * self._row_elems[i]
+                    buf = (ctypes.c_int32 * n_el).from_address(out_ptrs[i])
+                    batch[k] = np.frombuffer(buf, np.int32).reshape(shape)
+                placed = make_global_batch(
+                    self.mesh, batch, pspec=TRAIN_BATCH_PSPEC
+                )
+                yield placed
+                held.append((slot, placed))
+                if len(held) > 2:  # normally a no-op sync by now
+                    release(*held.pop(0))
+        finally:
+            for slot, placed in held:
+                release(slot, placed)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.batcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

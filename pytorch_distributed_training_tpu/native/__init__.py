@@ -1,0 +1,87 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime is native where it matters — DataLoader worker pools,
+NCCL/Gloo collectives, CUDA allocator all live in C++ under torch. On TPU the
+collective/allocator layer IS the XLA runtime; what remains genuinely
+host-side — batch assembly — is implemented here in C++ (native/src/) and
+driven through a minimal ctypes ABI (no pybind11 in this image).
+
+The shared library builds lazily on first use with the system toolchain and
+caches under ``native/build/``. Everything degrades gracefully: if no C++
+toolchain is available, ``load_batcher_lib()`` returns None and callers fall
+back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_NATIVE = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_REPO_NATIVE, "src", "batcher.cpp"))
+_BUILD_DIR = os.path.abspath(os.path.join(_REPO_NATIVE, "build"))
+_LIB = os.path.join(_BUILD_DIR, "libbatcher.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> str | None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # rebuild when the source is newer than the cached library
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _LIB
+
+
+def load_batcher_lib() -> ctypes.CDLL | None:
+    """Compile (once) and load the native batcher; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _compile()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.batcher_create.restype = ctypes.c_void_p
+        lib.batcher_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),  # const int32** arrays
+            ctypes.POINTER(ctypes.c_int64),   # row_elems
+            ctypes.c_int32,                   # n_arrays
+            ctypes.c_int64,                   # n_rows
+            ctypes.c_int64,                   # accum
+            ctypes.c_int64,                   # micro_global
+            ctypes.c_int64,                   # micro_local
+            ctypes.c_int64,                   # local_off
+            ctypes.c_int32,                   # n_slots
+            ctypes.c_int32,                   # n_threads
+        ]
+        lib.batcher_start_epoch.restype = ctypes.c_int64
+        lib.batcher_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.batcher_next.restype = ctypes.c_int32
+        lib.batcher_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)
+        ]
+        lib.batcher_release.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.batcher_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_batcher_lib() is not None

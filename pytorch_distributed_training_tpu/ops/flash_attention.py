@@ -511,6 +511,24 @@ def flash_attention_base(
     )
 
 
+def _flash_backend_ok() -> bool:
+    """Mosaic lowers on TPU only; elsewhere the kernel runs solely under
+    ``pltpu.force_tpu_interpret_mode`` (tests). Off-TPU without that context,
+    dispatch falls back to the reference implementation instead of failing
+    to lower — e.g. the gpt2 presets (attention_impl="flash") on a CPU-only
+    host."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:  # private but the only observable for the interpret context
+        from jax._src import config as _jcfg
+
+        return (
+            _jcfg.pallas_tpu_interpret_mode_context_manager.value is not None
+        )
+    except Exception:
+        return False
+
+
 # ------------------------------------------------------------ registration
 
 
@@ -541,7 +559,13 @@ def flash_attention(
     bias_ok = bias is None or (
         bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1
     )
-    if not bias_ok or q_len % block_q or kv_len % block_k or head_dim > 256:
+    if (
+        not _flash_backend_ok()
+        or not bias_ok
+        or q_len % block_q
+        or kv_len % block_k
+        or head_dim > 256
+    ):
         return reference_attention(
             q, k, v, bias,
             dropout_rng=dropout_rng, dropout_rate=dropout_rate,

@@ -111,12 +111,7 @@ class Trainer:
             }
         if num_labels:
             self.mcfg.num_labels = num_labels
-        self.train_loader = ShardedLoader(
-            train_data, self.mesh,
-            global_batch_size=train_config.global_batch_size,
-            grad_accum_steps=train_config.grad_accum_steps,
-            train=True, seed=train_config.seed,
-        )
+        self.train_loader = self._make_train_loader(train_data, train_config)
         self.eval_loader = ShardedLoader(
             eval_data, self.mesh,
             global_batch_size=train_config.eval_batch_size,
@@ -185,6 +180,46 @@ class Trainer:
         )
         self.history: list[dict] = []
 
+    def _make_train_loader(self, train_data, train_config):
+        """Native C++ prefetching batcher when configured/available, else the
+        Python ShardedLoader (same iteration contract either way)."""
+        mode = train_config.native_loader
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"native_loader must be auto/on/off, got {mode!r}")
+        if mode != "off":
+            from pytorch_distributed_training_tpu.native import native_available
+
+            if native_available():
+                from pytorch_distributed_training_tpu.data.native_loader import (
+                    NativeShardedLoader,
+                )
+
+                try:
+                    loader = NativeShardedLoader(
+                        train_data, self.mesh,
+                        global_batch_size=train_config.global_batch_size,
+                        grad_accum_steps=train_config.grad_accum_steps,
+                        seed=train_config.seed,
+                    )
+                except TypeError as e:  # non-integer dataset arrays
+                    if mode == "on":
+                        raise
+                    log0(f"native loader declined ({e}); using Python loader")
+                else:
+                    log0("train loader: native C++ prefetching batcher")
+                    return loader
+            elif mode == "on":
+                raise RuntimeError(
+                    "native_loader='on' but the C++ batcher is unavailable "
+                    "(no toolchain?)"
+                )
+        return ShardedLoader(
+            train_data, self.mesh,
+            global_batch_size=train_config.global_batch_size,
+            grad_accum_steps=train_config.grad_accum_steps,
+            train=True, seed=train_config.seed,
+        )
+
     # ------------------------------------------------------------------ run
 
     def run(self) -> list[dict]:
@@ -205,6 +240,19 @@ class Trainer:
             f"{cfg.grad_accum_steps} × {cfg.global_batch_size // cfg.grad_accum_steps}), "
             f"mesh {dict(self.mesh.shape)}, {n_chips} chip(s)"
         )
+        try:
+            self._run_epochs(cfg, n_chips, start_epoch, skip_in_first_epoch)
+        finally:
+            # release native-loader worker threads / checkpoint threadpools
+            # even when a train step raises (NaN abort, OOM, interrupt)
+            if self.checkpointer:
+                self.checkpointer.close()
+            close = getattr(self.train_loader, "close", None)
+            if close:
+                close()
+        return self.history
+
+    def _run_epochs(self, cfg, n_chips, start_epoch, skip_in_first_epoch):
         with maybe_profile(cfg.profile_dir):
             for epoch in range(start_epoch, cfg.num_epochs):
                 epoch_t0 = time.perf_counter()
@@ -254,9 +302,6 @@ class Trainer:
                 log0(f"epoch {epoch}: {record}")
                 if self.checkpointer:
                     self.checkpointer.save(self.state)
-        if self.checkpointer:
-            self.checkpointer.close()
-        return self.history
 
     def evaluate(self) -> dict:
         if self.objective == "causal_lm":

@@ -160,7 +160,13 @@ def make_train_step(
     donate = (0,)
     if mesh is None:
         return jax.jit(train_step, donate_argnums=donate)
-    batch_sharding = NamedSharding(mesh, TRAIN_BATCH_PSPEC)
+    # With context parallelism the loader shards sequence dims per-leaf
+    # (comms.ingest._leaf_spec); None lets jit inherit that committed layout
+    # instead of forcing a replicated-on-seq reshard.
+    if mesh.shape.get("seq", 1) > 1:
+        batch_sharding = None
+    else:
+        batch_sharding = NamedSharding(mesh, TRAIN_BATCH_PSPEC)
     return jax.jit(
         train_step,
         donate_argnums=donate,
@@ -225,7 +231,10 @@ def make_eval_step(
         fn, keys = eval_step, MetricAccumulator.FIELDS
     if mesh is None:
         return jax.jit(fn)
-    batch_sharding = NamedSharding(mesh, P(BATCH_AXES))
+    if mesh.shape.get("seq", 1) > 1:
+        batch_sharding = None  # inherit the loader's seq-sharded layout
+    else:
+        batch_sharding = NamedSharding(mesh, P(BATCH_AXES))
     replicated = NamedSharding(mesh, P())
     return jax.jit(
         fn,

@@ -34,6 +34,10 @@ class MeshConfig:
       reference test_model_parallelism.py:40-89, generalized).
     - ``model`` — tensor/branch model parallelism (the TriBert branch axis,
       reference test_model_parallelism.py:92-163, and sharded matmuls).
+    - ``seq``   — sequence/context parallelism: activations sharded on the
+      sequence dim, attention computed by ring attention
+      (``ops.ring_attention``) with K/V blocks ppermuted around this axis.
+      Innermost so ring hops ride adjacent-chip ICI links.
 
     Any axis set to ``-1`` absorbs all remaining devices (at most one).
     """
@@ -42,11 +46,12 @@ class MeshConfig:
     fsdp: int = 1
     stage: int = 1
     model: int = 1
+    seq: int = 1
 
-    AXIS_NAMES = ("data", "fsdp", "stage", "model")
+    AXIS_NAMES = ("data", "fsdp", "stage", "model", "seq")
 
-    def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int]:
-        sizes = [self.data, self.fsdp, self.stage, self.model]
+    def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        sizes = [self.data, self.fsdp, self.stage, self.model, self.seq]
         n_fill = sum(1 for s in sizes if s == -1)
         if n_fill > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
@@ -212,6 +217,10 @@ class TrainConfig:
     resume: bool = False
     profile_dir: str | None = None  # enable jax.profiler traces when set
     debug_nans: bool = False
+    # Train-batch assembly engine: "auto" uses the native C++ prefetching
+    # batcher (native/src/batcher.cpp) when a toolchain is available, else
+    # the Python loader; "on" requires it; "off" forces the Python loader.
+    native_loader: str = "auto"
     # Dropout-key PRNG: "rbg" rides the TPU hardware generator (profiled
     # ~1.5x step speedup over threefry on bert-large — threefry's bit
     # arithmetic competes with the matmuls for VPU cycles); "threefry2x32"

@@ -34,13 +34,13 @@ def test_runtime_info_single_process(eight_devices):
 
 def test_mesh_default_all_data(eight_devices):
     mesh = build_mesh()
-    assert mesh.shape == {"data": 8, "fsdp": 1, "stage": 1, "model": 1}
+    assert mesh.shape == {"data": 8, "fsdp": 1, "stage": 1, "model": 1, "seq": 1}
     assert dp_degree(mesh) == 8
 
 
 def test_mesh_hybrid_shapes(eight_devices):
     mesh = build_mesh(MeshConfig(data=2, model=4))
-    assert mesh.shape == {"data": 2, "fsdp": 1, "stage": 1, "model": 4}
+    assert mesh.shape == {"data": 2, "fsdp": 1, "stage": 1, "model": 4, "seq": 1}
     mesh = build_mesh(MeshConfig(data=-1, stage=2))
     assert mesh.shape["data"] == 4 and mesh.shape["stage"] == 2
 

@@ -1,0 +1,123 @@
+"""Native C++ batch assembler: build, correctness, determinism, lifecycle."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.native import native_available
+from pytorch_distributed_training_tpu.utils.config import MeshConfig
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="C++ toolchain unavailable"
+)
+
+
+def _dataset(n=64, seq=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(0, 100, (n, seq)).astype(np.int32),
+        "attention_mask": np.ones((n, seq), np.int32),
+        "labels": rng.integers(0, 2, n).astype(np.int32),
+    }
+
+
+def _gathered(batches):
+    """host-side [rows, ...] view of every yielded batch, merged."""
+    import jax
+
+    out = []
+    for b in batches:
+        host = {k: np.asarray(jax.device_get(v)) for k, v in b.items()}
+        out.append(host)
+    return out
+
+
+def test_every_row_exactly_once_per_epoch():
+    from pytorch_distributed_training_tpu.data.native_loader import (
+        NativeShardedLoader,
+    )
+
+    mesh = build_mesh(MeshConfig(data=8))
+    data = _dataset(n=64)
+    loader = NativeShardedLoader(
+        data, mesh, global_batch_size=16, grad_accum_steps=2, seed=7
+    )
+    batches = _gathered(loader.epoch(0))
+    assert len(batches) == 4  # 64 / 16
+    ids = np.concatenate(
+        [b["labels"].reshape(-1) for b in batches]
+    )
+    # labels were drawn iid; verify coverage via input_ids row identity
+    rows = np.concatenate(
+        [b["input_ids"].reshape(-1, 8) for b in batches]
+    )
+    assert rows.shape == (64, 8)
+    # every dataset row appears exactly once
+    orig = {r.tobytes() for r in data["input_ids"]}
+    got = [r.tobytes() for r in rows]
+    assert len(got) == len(set(got)) == len(orig)
+    assert set(got) == orig
+    # row alignment: labels travel with their rows
+    row_to_label = {
+        r.tobytes(): l for r, l in zip(data["input_ids"], data["labels"])
+    }
+    for b in batches:
+        for r, l in zip(
+            b["input_ids"].reshape(-1, 8), b["labels"].reshape(-1)
+        ):
+            assert row_to_label[r.tobytes()] == l
+    loader.close()
+
+
+def test_deterministic_and_epoch_varying():
+    from pytorch_distributed_training_tpu.data.native_loader import (
+        NativeShardedLoader,
+    )
+
+    mesh = build_mesh(MeshConfig(data=8))
+    data = _dataset(n=64)
+
+    def first_rows(seed, epoch):
+        loader = NativeShardedLoader(
+            data, mesh, global_batch_size=16, grad_accum_steps=1, seed=seed
+        )
+        b = next(iter(loader.epoch(epoch)))
+        import jax
+
+        rows = np.asarray(jax.device_get(b["input_ids"])).reshape(-1, 8)
+        loader.close()
+        return rows
+
+    a = first_rows(7, 0)
+    b = first_rows(7, 0)
+    np.testing.assert_array_equal(a, b)  # same seed+epoch → same order
+    c = first_rows(7, 1)
+    assert not np.array_equal(a, c)  # epochs reshuffle
+
+
+def test_trainer_runs_with_native_loader():
+    """End-to-end: Trainer with native_loader='on' trains and evals."""
+    from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+    from pytorch_distributed_training_tpu.train.loop import Trainer
+    from pytorch_distributed_training_tpu.utils.config import (
+        TrainConfig,
+        model_preset,
+    )
+
+    mcfg = model_preset("tiny", compute_dtype="float32")
+    tcfg = TrainConfig(
+        num_epochs=1,
+        global_batch_size=32,
+        micro_batch_size=16,
+        eval_batch_size=32,
+        train_size=128,
+        eval_size=64,
+        log_every=0,
+        bf16=False,
+        native_loader="on",
+    )
+    trainer = Trainer(
+        mcfg, tcfg, MeshConfig(data=8), ShardingPolicy(), task="synthetic"
+    )
+    history = trainer.run()
+    assert history and "accuracy" in history[-1]

@@ -1,0 +1,182 @@
+"""Ring attention parity on the 8-device CPU mesh.
+
+The contract: with the sequence dim sharded over the mesh ``seq`` axis, ring
+attention computes EXACTLY what single-device attention computes — same
+online-softmax math as flash, with K/V blocks arriving via ppermute instead
+of a VMEM loop. Tests gather the sharded output and compare against the
+reference einsum implementation, including causal masking with global
+positions (the part a naive per-shard implementation gets wrong) and
+gradient flow through the unrolled ring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_bias,
+    reference_attention,
+)
+from pytorch_distributed_training_tpu.utils.config import MeshConfig
+
+
+@pytest.fixture()
+def seq_mesh():
+    return build_mesh(MeshConfig(data=2, seq=4))
+
+
+def _qkv(batch=4, seq=32, heads=2, head_dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(batch, seq, heads, head_dim)), jnp.float32
+    )
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(seq_mesh, causal):
+    q, k, v = _qkv()
+    mask = np.ones((4, 32), np.int32)
+    mask[1, 20:] = 0  # padding crossing shard boundaries (shards of 8)
+    mask[3, 5:] = 0
+    bias = make_attention_bias(jnp.asarray(mask))
+
+    out = jax.jit(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, bias, impl="ring", causal=causal
+        )
+    )(q, k, v)
+    ref = reference_attention(q, k, v, bias, causal=causal)
+    # compare only valid query rows (padded-query rows are garbage in both)
+    for b in range(4):
+        n = int(mask[b].sum())
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n]), np.asarray(ref[b, :n]),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grad_matches_reference(seq_mesh, causal):
+    q, k, v = _qkv(seed=1)
+    cot = jnp.asarray(np.random.default_rng(2).normal(size=q.shape), jnp.float32)
+
+    def loss(fn):
+        def inner(q, k, v):
+            return jnp.sum(fn(q, k, v) * cot)
+        return inner
+
+    ring = lambda q, k, v: dot_product_attention(
+        q, k, v, None, impl="ring", causal=causal
+    )
+    ref = lambda q, k, v: reference_attention(q, k, v, None, causal=causal)
+    g_ring = jax.jit(jax.grad(loss(ring), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"d{name} (causal={causal})",
+        )
+
+
+def test_ring_dropout_runs_and_masks(seq_mesh):
+    """Dropout path: output differs from deterministic, zero-rate matches."""
+    q, k, v = _qkv(seed=3)
+    rng = jax.random.key(0)
+    out_det = dot_product_attention(q, k, v, None, impl="ring")
+    out_drop = dot_product_attention(
+        q, k, v, None, impl="ring",
+        dropout_rng=rng, dropout_rate=0.5, deterministic=False,
+    )
+    assert not np.allclose(np.asarray(out_det), np.asarray(out_drop))
+    out_zero = dot_product_attention(
+        q, k, v, None, impl="ring",
+        dropout_rng=rng, dropout_rate=0.0, deterministic=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_det), np.asarray(out_zero), atol=1e-6
+    )
+
+
+def test_ring_falls_back_without_seq_axis():
+    mesh = build_mesh(MeshConfig(data=-1))  # seq axis size 1
+    assert mesh.shape["seq"] == 1
+    q, k, v = _qkv(seed=4)
+    out = dot_product_attention(q, k, v, None, impl="ring")
+    ref = reference_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_context_parallel_train_step_parity():
+    """Full jitted train step on a (data=2, seq=4) mesh with ring attention
+    == the same step on a data-only mesh with reference attention: the CP
+    slice (seq-sharded loader layout + shard_map ring inside GSPMD) changes
+    the schedule, not the math."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.data.pipeline import ShardedLoader
+    from pytorch_distributed_training_tpu.data.synthetic import (
+        synthetic_pair_task,
+    )
+    from pytorch_distributed_training_tpu.models import (
+        BertForSequenceClassification,
+    )
+    from pytorch_distributed_training_tpu.parallel import (
+        ShardingPolicy,
+        state_shardings,
+    )
+    from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+    from pytorch_distributed_training_tpu.train.optim import (
+        adamw_with_schedule,
+    )
+    from pytorch_distributed_training_tpu.train.state import create_train_state
+    from pytorch_distributed_training_tpu.utils.config import (
+        TrainConfig,
+        model_preset,
+    )
+
+    losses = {}
+    for name, mesh_cfg, impl in [
+        ("dp", MeshConfig(data=8), "reference"),
+        ("cp", MeshConfig(data=2, seq=4), "ring"),
+    ]:
+        mesh = build_mesh(mesh_cfg)
+        mcfg = model_preset(
+            "tiny", compute_dtype="float32", attention_impl=impl,
+            hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        model = BertForSequenceClassification(mcfg)
+        tcfg = TrainConfig(
+            global_batch_size=16, micro_batch_size=8, max_seq_length=32,
+            prng_impl="threefry2x32",
+        )
+        tx, _ = adamw_with_schedule(tcfg, total_steps=4)
+        ex = {
+            "input_ids": jnp.ones((2, 32), jnp.int32),
+            "attention_mask": jnp.ones((2, 32), jnp.int32),
+            "token_type_ids": jnp.zeros((2, 32), jnp.int32),
+        }
+        state = create_train_state(
+            model, tx, jax.random.key(0, impl="threefry2x32"), ex
+        )
+        sh = state_shardings(state, ShardingPolicy(), mesh)
+        state = shard_state(state, sh)
+        from pytorch_distributed_training_tpu.train.step import make_train_step
+
+        step = make_train_step(
+            grad_accum_steps=tcfg.grad_accum_steps, mesh=mesh,
+            state_shardings=sh,
+        )
+        data = synthetic_pair_task(32, max_length=32, vocab_size=1024, seed=0)
+        loader = ShardedLoader(
+            data, mesh, global_batch_size=16,
+            grad_accum_steps=tcfg.grad_accum_steps, train=True, seed=0,
+        )
+        state, metrics = step(state, next(iter(loader.epoch(0))))
+        losses[name] = float(jax.device_get(metrics["loss"]))
+
+    np.testing.assert_allclose(losses["dp"], losses["cp"], rtol=1e-5)
