@@ -56,6 +56,8 @@ struct Batcher {
   std::atomic<int64_t> next_claim{0};   // producer work queue
   std::atomic<int64_t> consumed{0};     // consumer cursor
   uint64_t epoch_gen = 0;               // bumped per start_epoch
+  int64_t fills_in_flight = 0;          // workers currently inside fill()
+  std::condition_variable cv_quiesce;   // start_epoch waits for 0
 
   // ring
   std::vector<Slot> slots;
@@ -112,6 +114,7 @@ struct Batcher {
         if (step >= n_steps) continue;  // raced past the end
         slots[step % slots.size()].in_use.store(true,
                                                 std::memory_order_release);
+        ++fills_in_flight;
       }
       Slot& sl = slots[step % slots.size()];
       fill(step, sl);
@@ -119,9 +122,13 @@ struct Batcher {
         std::lock_guard<std::mutex> lk(mu);
         if (gen == epoch_gen) {
           sl.ready_step.store(step, std::memory_order_release);
-          sl.in_use.store(false, std::memory_order_release);
           cv_ready.notify_all();
         }
+        // stale (superseded-epoch) fills publish nothing, but ALWAYS give
+        // the slot back — start_epoch has quiesced, so no new-epoch worker
+        // can have touched it concurrently
+        sl.in_use.store(false, std::memory_order_release);
+        if (--fills_in_flight == 0) cv_quiesce.notify_all();
       }
     }
   }
@@ -164,8 +171,14 @@ Batcher* batcher_create(const int32_t** arrays, const int64_t* row_elems,
 // the wrapper — identical to the Python loader's order). Returns the number
 // of steps in the epoch.
 int64_t batcher_start_epoch(Batcher* b, const int64_t* perm) {
-  std::lock_guard<std::mutex> lk(b->mu);
+  std::unique_lock<std::mutex> lk(b->mu);
+  // Supersede the old epoch FIRST so in-flight fills discard their result,
+  // then quiesce: fill() reads b->perm and writes slot buffers, so both the
+  // perm.assign below and new-epoch fills must not overlap a stale fill
+  // (an abandoned epoch's generator leaves workers mid-fill).
   b->epoch_gen++;
+  b->next_claim.store(b->n_steps, std::memory_order_release);  // no new claims
+  b->cv_quiesce.wait(lk, [&] { return b->fills_in_flight == 0; });
   b->perm.assign(perm, perm + b->n_rows);
   const int64_t gb = b->accum * b->micro_global;
   b->n_steps = b->n_rows / gb;  // drop ragged tail (train semantics)

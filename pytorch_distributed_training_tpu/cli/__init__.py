@@ -1,0 +1,8 @@
+
+
+def resolve_attention(attention_arg, mesh_seq: int):
+    """Shared CLI rule: explicit --attention wins; otherwise ring when a
+    context-parallel mesh is requested; otherwise the model preset's
+    default. Returns a model_preset override dict."""
+    attention = attention_arg or ("ring" if mesh_seq > 1 else None)
+    return {"attention_impl": attention} if attention else {}

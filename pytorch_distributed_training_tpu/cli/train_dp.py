@@ -49,6 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-fsdp", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1,
                    help="context-parallel degree (ring attention)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="restart-from-checkpoint attempts after a crash "
+                        "(needs --checkpoint-dir; sets resume on retries)")
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -57,19 +60,33 @@ def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     tcfg = dataclass_from_args(TrainConfig, args)
     # bf16 flag maps onto the model dtype policy
-    attention = args.attention or ("ring" if args.mesh_seq > 1 else None)
-    overrides = dict(
+    from pytorch_distributed_training_tpu.cli import resolve_attention
+
+    mcfg = model_preset(
+        args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
+        **resolve_attention(args.attention, args.mesh_seq),
     )
-    if attention:
-        overrides["attention_impl"] = attention
-    mcfg = model_preset(args.model, **overrides)
     mesh_cfg = MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp, seq=args.mesh_seq
     )
     policy = ShardingPolicy(fsdp=args.fsdp)
-    trainer = Trainer(mcfg, tcfg, mesh_cfg, policy, task=args.task)
-    return trainer.run()
+    if args.max_restarts and not tcfg.checkpoint_dir:
+        raise SystemExit("--max-restarts needs --checkpoint-dir to resume from")
+
+    def attempt(i: int):
+        import dataclasses
+
+        cfg = dataclasses.replace(tcfg, resume=tcfg.resume or i > 0)
+        return Trainer(
+            mcfg, cfg, mesh_cfg, policy, task=args.task
+        ).run()
+
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    return run_with_restarts(attempt, max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":

@@ -65,14 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     tcfg = dataclass_from_args(TrainConfig, args)
-    attention = args.attention or ("ring" if args.mesh_seq > 1 else None)
-    overrides = dict(
+    from pytorch_distributed_training_tpu.cli import resolve_attention
+
+    mcfg = model_preset(
+        args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         scan_layers=args.mp_mode == "stage",
+        **resolve_attention(args.attention, args.mesh_seq),
     )
-    if attention:
-        overrides["attention_impl"] = attention
-    mcfg = model_preset(args.model, **overrides)
     mesh_cfg = MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp,
         stage=args.mesh_stage, model=args.mesh_model, seq=args.mesh_seq,

@@ -52,6 +52,14 @@ def current_mesh() -> Mesh | None:
     return _CURRENT_MESH
 
 
+def set_current_mesh(mesh: Mesh) -> None:
+    """Re-pin the mesh mesh-registry consumers (ring attention) resolve
+    against. ``Trainer.run`` calls this so retraces during ITS run always see
+    ITS mesh even if another mesh was built later in the same process."""
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
 def build_mesh(
     config: MeshConfig | None = None,
     *,

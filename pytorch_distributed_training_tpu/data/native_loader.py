@@ -33,10 +33,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
-from pytorch_distributed_training_tpu.comms.mesh import (
-    TRAIN_BATCH_PSPEC,
-    dp_degree,
-)
+from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
 from pytorch_distributed_training_tpu.native import load_batcher_lib
 
 _RING_SLOTS = 4
@@ -70,21 +67,20 @@ class NativeShardedLoader:
         self.accum = grad_accum_steps
         self.train = True
 
-        self.pidx = jax.process_index() if process_index is None else process_index
-        self.pcount = jax.process_count() if process_count is None else process_count
-        if global_batch_size % (self.accum * self.pcount):
-            raise ValueError(
-                f"global batch {global_batch_size} must divide by "
-                f"accum*processes ({self.accum}*{self.pcount})"
+        from pytorch_distributed_training_tpu.data.pipeline import (
+            resolve_batch_geometry,
+        )
+
+        self.pidx, self.pcount, micro_global, micro_local, _ = (
+            resolve_batch_geometry(
+                mesh,
+                global_batch_size=global_batch_size,
+                grad_accum_steps=grad_accum_steps,
+                train=True,
+                process_index=process_index,
+                process_count=process_count,
             )
-        dp = dp_degree(mesh)
-        micro_global = global_batch_size // self.accum
-        if micro_global % dp:
-            raise ValueError(
-                f"micro batch {micro_global} must divide by data-parallel "
-                f"degree {dp}"
-            )
-        micro_local = micro_global // self.pcount
+        )
 
         # int32, C-contiguous copies the C++ side can point at; keys sorted
         # for a deterministic array order across hosts.
@@ -142,6 +138,8 @@ class NativeShardedLoader:
         held: list[tuple[int, dict]] = []
 
         def release(slot, placed):
+            if self._handle is None:  # close() already destroyed the batcher
+                return
             # the slot's buffers may be overwritten once released: make sure
             # the device transfer that read them has completed
             jax.block_until_ready(placed)

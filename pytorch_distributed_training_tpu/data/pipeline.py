@@ -31,6 +31,40 @@ from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
 from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC, dp_degree
 
 
+def resolve_batch_geometry(
+    mesh: Mesh,
+    *,
+    global_batch_size: int,
+    grad_accum_steps: int,
+    train: bool,
+    process_index: int | None = None,
+    process_count: int | None = None,
+):
+    """Validate and derive the per-host batch geometry — THE shared contract
+    between the Python and native loader engines (they must be
+    interchangeable mid-run, so the rules live in exactly one place).
+
+    Returns (pidx, pcount, micro_global, micro_local, local_per_step).
+    """
+    pidx = jax.process_index() if process_index is None else process_index
+    pcount = jax.process_count() if process_count is None else process_count
+    accum = grad_accum_steps if train else 1
+    if global_batch_size % (accum * pcount):
+        raise ValueError(
+            f"global batch {global_batch_size} must divide by "
+            f"accum*processes ({accum}*{pcount})"
+        )
+    dp = dp_degree(mesh)
+    micro_global = global_batch_size // accum
+    if micro_global % dp:
+        raise ValueError(
+            f"{'micro' if train else 'eval'} batch {micro_global} must "
+            f"divide by data-parallel degree {dp}"
+        )
+    micro_local = micro_global // pcount
+    return pidx, pcount, micro_global, micro_local, global_batch_size // pcount
+
+
 class ShardedLoader:
     """Iterates global sharded batches from per-host numpy arrays.
 
@@ -60,22 +94,20 @@ class ShardedLoader:
         self.global_batch = global_batch_size
         self.accum = grad_accum_steps if train else 1
         self.n = len(next(iter(data.values())))
-        self.pidx = jax.process_index() if process_index is None else process_index
-        self.pcount = jax.process_count() if process_count is None else process_count
-        if global_batch_size % (self.accum * self.pcount):
-            raise ValueError(
-                f"global batch {global_batch_size} must divide by "
-                f"accum*processes ({self.accum}*{self.pcount})"
-            )
-        dp = dp_degree(mesh)
-        micro = global_batch_size // self.accum
-        if micro % dp:
-            # applies to eval too: eval batches shard dim 0 over dp as well
-            raise ValueError(
-                f"{'micro' if train else 'eval'} batch {micro} must divide "
-                f"by data-parallel degree {dp}"
-            )
-        self.local_per_step = global_batch_size // self.pcount
+        (
+            self.pidx,
+            self.pcount,
+            _micro_global,
+            _micro_local,
+            self.local_per_step,
+        ) = resolve_batch_geometry(
+            mesh,
+            global_batch_size=global_batch_size,
+            grad_accum_steps=grad_accum_steps,
+            train=train,
+            process_index=process_index,
+            process_count=process_count,
+        )
 
     @property
     def steps_per_epoch(self) -> int:

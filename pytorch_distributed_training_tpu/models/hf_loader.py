@@ -150,3 +150,90 @@ def load_bert_classifier(source: Any, config: ModelConfig) -> dict:
         lambda x: x.astype(pdtype) if np.issubdtype(x.dtype, np.floating) else x,
         params,
     )
+
+
+def load_gpt2_lm(source: Any, config: ModelConfig) -> dict:
+    """Build the flax params pytree for ``GPT2LMModel`` from an HF
+    ``GPT2LMHeadModel`` checkpoint.
+
+    HF GPT-2 uses ``Conv1D`` modules whose weights are stored [in, out] —
+    already the flax kernel orientation, so unlike the BERT path nothing
+    transposes. The fused ``c_attn`` [h, 3h] splits into the framework's
+    separate q/k/v DenseGeneral kernels ([h, heads, head_dim]); the LM head
+    is weight-tied to ``wte`` (both here and in HF), so only the embedding
+    loads. With ``config.scan_layers`` the per-layer trees stack on a
+    leading [num_layers] axis (the lax.scan trunk layout).
+    """
+    sd = state_dict_from(source)
+    n, d, h = config.num_heads, config.head_dim, config.hidden_size
+    prefix = (
+        "transformer."
+        if any(k.startswith("transformer.") for k in sd)
+        else ""
+    )
+
+    def arr(key):
+        return _np(sd[key])
+
+    def norm(key):
+        return {"scale": arr(key + ".weight"), "bias": arr(key + ".bias")}
+
+    def layer(i):
+        lp = f"{prefix}h.{i}."
+        ck, cb = arr(lp + "attn.c_attn.weight"), arr(lp + "attn.c_attn.bias")
+        q_k, k_k, v_k = np.split(ck, 3, axis=1)  # [h, h] each
+        q_b, k_b, v_b = np.split(cb, 3)
+        return {
+            "ln_1": norm(lp + "ln_1"),
+            "attention": {
+                "query": {
+                    "kernel": q_k.reshape(h, n, d),
+                    "bias": q_b.reshape(n, d),
+                },
+                "key": {
+                    "kernel": k_k.reshape(h, n, d),
+                    "bias": k_b.reshape(n, d),
+                },
+                "value": {
+                    "kernel": v_k.reshape(h, n, d),
+                    "bias": v_b.reshape(n, d),
+                },
+                "out": {
+                    "kernel": arr(lp + "attn.c_proj.weight").reshape(n, d, h),
+                    "bias": arr(lp + "attn.c_proj.bias"),
+                },
+            },
+            "ln_2": norm(lp + "ln_2"),
+            "mlp_up": {
+                "kernel": arr(lp + "mlp.c_fc.weight"),
+                "bias": arr(lp + "mlp.c_fc.bias"),
+            },
+            "mlp_down": {
+                "kernel": arr(lp + "mlp.c_proj.weight"),
+                "bias": arr(lp + "mlp.c_proj.bias"),
+            },
+        }
+
+    layers = [layer(i) for i in range(config.num_layers)]
+    params: dict[str, Any] = {
+        "wte": {"embedding": arr(prefix + "wte.weight")},
+        "wpe": {"embedding": arr(prefix + "wpe.weight")},
+        "ln_f": norm(prefix + "ln_f"),
+    }
+    if config.scan_layers:
+        import jax
+
+        params["layers_scan"] = {
+            "block": jax.tree.map(lambda *xs: np.stack(xs), *layers)
+        }
+    else:
+        for i, lyr in enumerate(layers):
+            params[f"block_{i}"] = lyr
+
+    pdtype = np.dtype(config.param_dtype)
+    import jax
+
+    return jax.tree.map(
+        lambda x: x.astype(pdtype) if np.issubdtype(x.dtype, np.floating) else x,
+        params,
+    )

@@ -30,10 +30,17 @@ _tried = False
 
 
 def _compile() -> str | None:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    # rebuild when the source is newer than the cached library
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        # rebuild when the source is newer than the cached library
+        if os.path.exists(_LIB) and os.path.exists(_SRC) and (
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return _LIB
+        if not os.path.exists(_SRC):
+            return None
+    except OSError:
+        return None
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         _SRC, "-o", _LIB,
@@ -55,7 +62,12 @@ def load_batcher_lib() -> ctypes.CDLL | None:
         path = _compile()
         if path is None:
             return None
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            # a stale/foreign-platform cached .so must degrade to the
+            # Python loader, not crash every Trainer construction
+            return None
         lib.batcher_create.restype = ctypes.c_void_p
         lib.batcher_create.argtypes = [
             ctypes.POINTER(ctypes.c_void_p),  # const int32** arrays

@@ -49,6 +49,7 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _LANES = 128  # minor-dim tile width for fp32 stats outputs
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+_WARNED_NO_INTERPRET_PROBE = False
 
 
 def _keep_mask(shape, rate: float):
@@ -526,6 +527,17 @@ def _flash_backend_ok() -> bool:
             _jcfg.pallas_tpu_interpret_mode_context_manager.value is not None
         )
     except Exception:
+        global _WARNED_NO_INTERPRET_PROBE
+        if not _WARNED_NO_INTERPRET_PROBE:
+            _WARNED_NO_INTERPRET_PROBE = True
+            import warnings
+
+            warnings.warn(
+                "jax private interpret-mode probe unavailable (jax upgrade?) "
+                "— flash attention disabled off-TPU; update "
+                "_flash_backend_ok (tests/test_flash_attention.py asserts "
+                "this probe works, so a green suite means flash is live)"
+            )
         return False
 
 

@@ -149,13 +149,14 @@ class Trainer:
             example
         )
         if hf_checkpoint is not None:
-            from pytorch_distributed_training_tpu.models.hf_loader import (
-                load_bert_classifier,
-            )
+            from pytorch_distributed_training_tpu.models import hf_loader
 
-            state = state.replace(
-                params=load_bert_classifier(hf_checkpoint, self.mcfg)
+            load = (
+                hf_loader.load_gpt2_lm
+                if self.mcfg.causal
+                else hf_loader.load_bert_classifier
             )
+            state = state.replace(params=load(hf_checkpoint, self.mcfg))
         self.shardings = state_shardings(state, self.policy, self.mesh)
         self.state = shard_state(state, self.shardings)
 
@@ -223,6 +224,9 @@ class Trainer:
     # ------------------------------------------------------------------ run
 
     def run(self) -> list[dict]:
+        from pytorch_distributed_training_tpu.comms.mesh import set_current_mesh
+
+        set_current_mesh(self.mesh)  # ring attention retraces resolve to OUR mesh
         cfg = self.tcfg
         n_chips = self.info.global_device_count
         spe = max(self.train_loader.steps_per_epoch, 1)

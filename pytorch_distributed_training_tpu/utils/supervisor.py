@@ -1,0 +1,66 @@
+"""Failure detection + restart-from-checkpoint supervision.
+
+The reference's entire failure story is crash propagation: ``mp.spawn(...,
+join=True)`` re-raises a child's death and the run is simply over (reference
+test_model_parallelism.py:333-335) — no retry, no elasticity, no health
+checks (SURVEY.md §5). The TPU framework's recovery story is
+restart-from-checkpoint: ``jax.distributed`` already propagates coordinator
+failure to every process (the detection half), and this module supplies the
+recovery half — re-run the training function, which resumes from the latest
+checkpoint (``TrainConfig.resume=True`` + ``checkpoint_dir``) and continues
+the exact optimizer/data trajectory (mid-epoch resume, train/loop.py).
+
+Transient infra failures (preemption, a flaky host, one bad allreduce) get
+``max_restarts`` fresh attempts with exponential backoff; deterministic
+failures (a real bug) burn the attempts quickly and the final exception
+propagates unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+from pytorch_distributed_training_tpu.utils.logging import log0
+
+T = TypeVar("T")
+
+
+def run_with_restarts(
+    make_attempt: Callable[[int], T],
+    *,
+    max_restarts: int = 0,
+    backoff_s: float = 5.0,
+    backoff_factor: float = 2.0,
+    max_backoff_s: float = 300.0,
+    on_failure: Callable[[int, BaseException], None] | None = None,
+) -> T:
+    """Call ``make_attempt(attempt_index)`` until it returns, restarting on
+    exception up to ``max_restarts`` times.
+
+    ``make_attempt`` must build a FRESH run each call (new Trainer with
+    ``resume=True``): a failed attempt's runtime state — devices, loaders,
+    jit caches — is assumed poisoned; only the checkpoint survives. Raises
+    the last failure when attempts are exhausted. KeyboardInterrupt is never
+    retried.
+    """
+    attempt = 0
+    delay = backoff_s
+    while True:
+        try:
+            return make_attempt(attempt)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            if on_failure is not None:
+                on_failure(attempt, e)
+            if attempt >= max_restarts:
+                raise
+            log0(
+                f"attempt {attempt} failed ({type(e).__name__}: {e}); "
+                f"restarting from latest checkpoint in {delay:.0f}s "
+                f"({max_restarts - attempt} restart(s) left)"
+            )
+            time.sleep(delay)
+            delay = min(delay * backoff_factor, max_backoff_s)
+            attempt += 1

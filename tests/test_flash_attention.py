@@ -39,6 +39,22 @@ def _padding_mask(batch=2, seq=32, valid_lens=(32, 17)):
     return jnp.asarray(mask)
 
 
+def test_interpret_probe_sees_context():
+    """The dispatch guard must recognize force_tpu_interpret_mode — if this
+    breaks (jax private-API move), every parity test below would silently
+    compare reference to itself."""
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        _flash_backend_ok,
+    )
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        assert not _flash_backend_ok()
+    with pltpu.force_tpu_interpret_mode():
+        assert _flash_backend_ok()
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_matches_reference_fwd(causal):
     q, k, v = _qkv()

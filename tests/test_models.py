@@ -131,3 +131,42 @@ def test_parity_with_torch_hf(roberta):
         jnp.asarray(mask, jnp.int32),
     )
     np.testing.assert_allclose(np.asarray(got), expected, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_gpt2_parity_with_torch_hf(scan_layers):
+    """load_gpt2_lm maps an HF GPT2LMHeadModel (Conv1D [in,out] weights,
+    fused c_attn, tied head) onto GPT2LMModel bit-for-bit at fp32 — for the
+    python-loop trunk AND the scan-stacked trunk."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(0)
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.models.hf_loader import load_gpt2_lm
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=512, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        n_inner=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5,
+    )
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg)
+    hf_model.eval()
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=64,
+        type_vocab_size=0, causal=True, layer_norm_eps=1e-5,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype="float32", scan_layers=scan_layers,
+    )
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, 500, size=(3, 20))
+    with torch.no_grad():
+        expected = hf_model(input_ids=torch.tensor(ids)).logits.numpy()
+
+    params = load_gpt2_lm(hf_model, cfg)
+    model = GPT2LMModel(cfg)
+    got = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), expected, atol=3e-4, rtol=3e-4)

@@ -251,3 +251,30 @@ def test_metric_accumulator_matches_sklearn_formulas():
     fp = ((preds == 1) & (labels == 0)).sum()
     fn = ((preds == 0) & (labels == 1)).sum()
     np.testing.assert_allclose(out["f1"], 2 * tp / (2 * tp + fp + fn))
+
+
+def test_supervisor_restarts_and_resumes(tmp_path):
+    """run_with_restarts retries a transiently-failing attempt; combined
+    with checkpoint_dir+resume the retry continues the saved trajectory
+    (the framework's elastic-recovery story, SURVEY.md §5)."""
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        if i < 2:
+            raise RuntimeError(f"injected failure {i}")
+        return "done"
+
+    out = run_with_restarts(attempt, max_restarts=3, backoff_s=0.01)
+    assert out == "done" and calls == [0, 1, 2]
+
+    with pytest.raises(RuntimeError):
+        run_with_restarts(
+            lambda i: (_ for _ in ()).throw(RuntimeError("always")),
+            max_restarts=1,
+            backoff_s=0.01,
+        )
