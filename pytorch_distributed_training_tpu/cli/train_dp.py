@@ -73,6 +73,20 @@ def main(argv=None) -> list[dict]:
     policy = ShardingPolicy(fsdp=args.fsdp)
     if args.max_restarts and not tcfg.checkpoint_dir:
         raise SystemExit("--max-restarts needs --checkpoint-dir to resume from")
+    if args.max_restarts and not tcfg.resume:
+        # a retry resumes from the LATEST checkpoint in the dir — if an older
+        # run left one there, attempt 1+ would silently continue that run's
+        # trajectory instead of this one's
+        from pytorch_distributed_training_tpu.train.checkpoint import (
+            latest_step,
+        )
+
+        if latest_step(tcfg.checkpoint_dir) is not None:
+            raise SystemExit(
+                f"checkpoint dir {tcfg.checkpoint_dir!r} already holds a "
+                f"checkpoint; pass --resume to continue it or point "
+                f"--checkpoint-dir at a fresh directory"
+            )
 
     def attempt(i: int):
         import dataclasses

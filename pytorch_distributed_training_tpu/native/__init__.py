@@ -32,12 +32,14 @@ _tried = False
 def _compile() -> str | None:
     try:
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        # rebuild when the source is newer than the cached library
-        if os.path.exists(_LIB) and os.path.exists(_SRC) and (
-            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
-        ):
-            return _LIB
-        if not os.path.exists(_SRC):
+        if os.path.exists(_LIB):
+            # no source shipped (prebuilt deployment) -> trust the library;
+            # otherwise rebuild when the source is newer than the cache
+            if not os.path.exists(_SRC) or (
+                os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+            ):
+                return _LIB
+        elif not os.path.exists(_SRC):
             return None
     except OSError:
         return None

@@ -322,6 +322,82 @@ def _dkv_kernel(
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
+def _dqkv_single_block_kernel(
+    seed_ref,
+    q_ref,  # [1, 1, S, D]
+    k_ref,  # [1, 1, S, D]
+    v_ref,  # [1, 1, S, D]
+    bias_ref,  # [1, 1, 1, S]
+    o_ref,  # [1, 1, S, D]
+    do_ref,  # [1, 1, S, D]
+    lse_ref,  # [1, 1, S, LANES]
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    scale: float,
+    causal: bool,
+    dropout_rate: float,
+):
+    """Fused dq/dk/dv when the whole sequence fits one block (grid (B, N)).
+
+    Short sequences (BERT at 128) pay mostly per-program overhead in the
+    two-pass backward; with one k-block and one q-block the dq and dk/dv
+    passes recompute the SAME probs, so fusing them halves the pallas
+    dispatches and reads q/k/v/do once. Uses block seed (bh, 0, 0) — the
+    same mask stream as the general kernels' single-block case.
+    """
+    b, n = pl.program_id(0), pl.program_id(1)
+    bh = b * pl.num_programs(1) + n
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    o = o_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, :1]
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [S, 1]
+
+    s = jax.lax.dot_general(
+        q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + bias_ref[0, 0, :, :]
+    if causal:
+        sq = q_ref.shape[2]
+        s = s + _causal_block_mask(0, 0, sq, sq)
+    p = jnp.exp(s - lse)  # normalized probs [S, S]
+
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if dropout_rate > 0.0:
+        pltpu.prng_seed(seed_ref[0], _block_seed(bh, 0, 0, 1, 1))
+        keep = _keep_mask(p.shape, dropout_rate)
+        p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+    else:
+        p_drop = p
+    dv_ref[0, 0, :, :] = jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    ds = p * (dp - delta)
+    dq_ref[0, 0, :, :] = (
+        jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    ).astype(dq_ref.dtype)
+    # q was pre-scaled: ds^T @ q already carries 1/sqrt(d)
+    dk_ref[0, 0, :, :] = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype)
+
+
 # ----------------------------------------------------------------- wrapper
 
 
@@ -393,6 +469,45 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
     scale = head_dim**-0.5
+
+    if q_len == block_q and kv_len == block_k:
+        full = pl.BlockSpec(
+            (1, 1, q_len, head_dim), lambda b, n, *_: (b, n, 0, 0)
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _dqkv_single_block_kernel,
+                scale=scale,
+                causal=causal,
+                dropout_rate=dropout_rate,
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(batch, heads),
+                in_specs=[
+                    full,
+                    full,
+                    full,
+                    pl.BlockSpec(
+                        (1, 1, 1, kv_len), lambda b, n, *_: (b, 0, 0, 0)
+                    ),
+                    full,
+                    full,
+                    pl.BlockSpec(
+                        (1, 1, q_len, _LANES), lambda b, n, *_: (b, n, 0, 0)
+                    ),
+                ],
+                out_specs=[full, full, full],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+        )(seed, q, k, v, bias, o, do, lse)
+        dbias = jnp.zeros_like(bias)
+        dseed = np.zeros(seed.shape, jax.dtypes.float0)
+        return dq, dk, dv, dbias, dseed
 
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1

@@ -138,3 +138,36 @@ def test_flash_dispatch_and_fallback():
     out = dot_product_attention(q, k, v, bias, impl="flash")
     ref = reference_attention(q, k, v, bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_multiblock_grad_matches_reference(causal):
+    """The general two-pass backward (dq + dkv kernels) — NOT the fused
+    single-block fast path — must stay correct: force multiple blocks with
+    block sizes smaller than the sequence."""
+    q, k, v = _qkv(seq=32, seed=6)
+    bias = jnp.zeros((2, 1, 1, 32), jnp.float32)
+    seed = jnp.zeros((1,), jnp.int32)
+    cot = jnp.asarray(
+        np.random.default_rng(7).normal(size=q.shape), jnp.float32
+    )
+
+    def loss_flash(q, k, v):
+        out = flash_attention_base(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), bias, seed,
+            causal=causal, block_q=16, block_k=16,
+        )
+        return jnp.sum(out.transpose(0, 2, 1, 3) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, None, causal=causal) * cot)
+
+    with pltpu.force_tpu_interpret_mode():
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"multi-block d{name} (causal={causal})",
+        )
