@@ -68,6 +68,14 @@ def run_bench(
     n_chips = jax.device_count()
     mesh = build_mesh()
     mcfg = model_preset(model_name)
+    need_pos = (
+        seq_len + mcfg.pad_token_id + 1 if mcfg.roberta_style else seq_len
+    )
+    if need_pos > mcfg.max_position_embeddings:
+        # long-context benches train from random init, so growing the
+        # position table is legitimate (a pretrained run would need
+        # interpolation instead)
+        mcfg.max_position_embeddings = need_pos
     if mcfg.causal:
         from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
 

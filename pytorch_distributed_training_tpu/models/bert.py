@@ -135,6 +135,13 @@ def default_position_ids(cfg: ModelConfig, input_ids):
     past the pad id; BERT uses plain arange. Shared by every trunk (single
     encoder AND the branch ensemble) so family semantics can't drift."""
     batch, seq = input_ids.shape
+    # roberta positions run pad_token_id+1 .. seq+pad_token_id (HF offset)
+    max_pos = seq + cfg.pad_token_id + 1 if cfg.roberta_style else seq
+    if max_pos > cfg.max_position_embeddings:
+        raise ValueError(
+            f"sequence length {seq} needs position ids up to {max_pos - 1} "
+            f"but max_position_embeddings is {cfg.max_position_embeddings}"
+        )
     if cfg.roberta_style:
         mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
         return jnp.cumsum(mask, axis=-1) * mask + cfg.pad_token_id

@@ -99,6 +99,13 @@ class GPT2LMModel(nn.Module):
     ):
         cfg = self.config
         batch, seq = input_ids.shape
+        if seq > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings} — the position-embedding "
+                f"gather would silently clamp (NaN/garbage logits); raise "
+                f"max_position_embeddings for long-context runs"
+            )
         if position_ids is None:
             position_ids = jnp.broadcast_to(
                 jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)

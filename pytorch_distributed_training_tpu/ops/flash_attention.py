@@ -470,7 +470,7 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
     kv_len = k.shape[2]
     scale = head_dim**-0.5
 
-    if q_len == block_q and kv_len == block_k:
+    if q_len == block_q and kv_len == block_k and q_len == kv_len:
         full = pl.BlockSpec(
             (1, 1, q_len, head_dim), lambda b, n, *_: (b, n, 0, 0)
         )
