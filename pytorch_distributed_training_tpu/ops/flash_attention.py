@@ -322,42 +322,28 @@ def _dkv_kernel(
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
-def _dqkv_single_block_kernel(
+def _fwd_single_save_probs_kernel(
     seed_ref,
     q_ref,  # [1, 1, S, D]
-    k_ref,  # [1, 1, S, D]
-    v_ref,  # [1, 1, S, D]
+    k_ref,
+    v_ref,
     bias_ref,  # [1, 1, 1, S]
-    o_ref,  # [1, 1, S, D]
-    do_ref,  # [1, 1, S, D]
-    lse_ref,  # [1, 1, S, LANES]
-    dq_ref,
-    dk_ref,
-    dv_ref,
+    o_ref,
+    probs_ref,  # [1, 1, S, S] normalized UNDROPPED probs (residual)
     *,
     scale: float,
     causal: bool,
     dropout_rate: float,
 ):
-    """Fused dq/dk/dv when the whole sequence fits one block (grid (B, N)).
-
-    Short sequences (BERT at 128) pay mostly per-program overhead in the
-    two-pass backward; with one k-block and one q-block the dq and dk/dv
-    passes recompute the SAME probs, so fusing them halves the pallas
-    dispatches and reads q/k/v/do once. Uses block seed (bh, 0, 0) — the
-    same mask stream as the general kernels' single-block case.
-    """
+    """Single-block forward that saves normalized probs as the backward
+    residual (grid (B, N)) — the same fwd/bwd work-sharing XLA applies to
+    the reference einsum attention, inside one fused kernel. Short-seq
+    residual memory is O(S^2) like XLA's, which is exactly the regime where
+    that is cheap."""
     b, n = pl.program_id(0), pl.program_id(1)
     bh = b * pl.num_programs(1) + n
-
     q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
     k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    do = do_ref[0, 0, :, :].astype(jnp.float32)
-    o = o_ref[0, 0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, :1]
-    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [S, 1]
-
     s = jax.lax.dot_general(
         q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -366,7 +352,49 @@ def _dqkv_single_block_kernel(
     if causal:
         sq = q_ref.shape[2]
         s = s + _causal_block_mask(0, 0, sq, sq)
-    p = jnp.exp(s - lse)  # normalized probs [S, S]
+    # floor the row max so fully-masked rows give zeros, not exp(-inf+inf)
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), _NEG_INF)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    probs = p / l
+    probs_ref[0, 0, :, :] = probs.astype(probs_ref.dtype)
+    if dropout_rate > 0.0:
+        pltpu.prng_seed(seed_ref[0], _block_seed(bh, 0, 0, 1, 1))
+        keep = _keep_mask(probs.shape, dropout_rate)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    v = v_ref[0, 0, :, :]
+    o_ref[0, 0, :, :] = jax.lax.dot_general(
+        probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def _dqkv_from_probs_kernel(
+    seed_ref,
+    q_ref,  # [1, 1, S, D]
+    k_ref,
+    v_ref,
+    probs_ref,  # [1, 1, S, S]
+    o_ref,
+    do_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    scale: float,
+    dropout_rate: float,
+):
+    """Backward from saved probs: no score recompute, no exp — four matmuls
+    (dp, dv, dq, dk) straight off the residual."""
+    b, n = pl.program_id(0), pl.program_id(1)
+    bh = b * pl.num_programs(1) + n
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    p = probs_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    o = o_ref[0, 0, :, :].astype(jnp.float32)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)
 
     dp = jax.lax.dot_general(
         do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -391,10 +419,12 @@ def _dqkv_single_block_kernel(
         )
         * scale
     ).astype(dq_ref.dtype)
-    # q was pre-scaled: ds^T @ q already carries 1/sqrt(d)
-    dk_ref[0, 0, :, :] = jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    dk_ref[0, 0, :, :] = (
+        jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
     ).astype(dk_ref.dtype)
 
 
@@ -457,7 +487,50 @@ def _flash(q, k, v, bias, seed, dropout_rate, causal, block_q, block_k):
     return o
 
 
+def _single_block(q, k, block_q, block_k):
+    q_len, kv_len = q.shape[2], k.shape[2]
+    return q_len == block_q and kv_len == block_k and q_len == kv_len
+
+
+def _flash_fwd_save_probs(q, k, v, bias, seed, dropout_rate, causal):
+    batch, heads, q_len, head_dim = q.shape
+    full = pl.BlockSpec((1, 1, q_len, head_dim), lambda b, n, *_: (b, n, 0, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_single_save_probs_kernel,
+            scale=head_dim**-0.5,
+            causal=causal,
+            dropout_rate=dropout_rate,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads),
+            in_specs=[
+                full,
+                full,
+                full,
+                pl.BlockSpec((1, 1, 1, q_len), lambda b, n, *_: (b, 0, 0, 0)),
+            ],
+            out_specs=[
+                full,
+                pl.BlockSpec((1, 1, q_len, q_len), lambda b, n, *_: (b, n, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            # fp32 residual: the backward's ds/dv quality must match the
+            # multi-block path's fp32 recompute (S<=128 keeps this cheap)
+            jax.ShapeDtypeStruct((batch, heads, q_len, q_len), jnp.float32),
+        ],
+    )(seed, q, k, v, bias)
+
+
 def _vjp_fwd(q, k, v, bias, seed, dropout_rate, causal, block_q, block_k):
+    if _single_block(q, k, block_q, block_k):
+        o, probs = _flash_fwd_save_probs(
+            q, k, v, bias, seed, dropout_rate, causal
+        )
+        return o, (q, k, v, bias, seed, o, probs)
     o, lse = _flash_fwd(
         q, k, v, bias, seed, dropout_rate, causal, block_q, block_k
     )
@@ -465,38 +538,27 @@ def _vjp_fwd(q, k, v, bias, seed, dropout_rate, causal, block_q, block_k):
 
 
 def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
-    q, k, v, bias, seed, o, lse = res
+    q, k, v, bias, seed, o, lse_or_probs = res
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
     scale = head_dim**-0.5
 
-    if q_len == block_q and kv_len == block_k and q_len == kv_len:
+    if _single_block(q, k, block_q, block_k):
+        probs = lse_or_probs
         full = pl.BlockSpec(
             (1, 1, q_len, head_dim), lambda b, n, *_: (b, n, 0, 0)
         )
+        sq = pl.BlockSpec((1, 1, q_len, q_len), lambda b, n, *_: (b, n, 0, 0))
         dq, dk, dv = pl.pallas_call(
             functools.partial(
-                _dqkv_single_block_kernel,
+                _dqkv_from_probs_kernel,
                 scale=scale,
-                causal=causal,
                 dropout_rate=dropout_rate,
             ),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(batch, heads),
-                in_specs=[
-                    full,
-                    full,
-                    full,
-                    pl.BlockSpec(
-                        (1, 1, 1, kv_len), lambda b, n, *_: (b, 0, 0, 0)
-                    ),
-                    full,
-                    full,
-                    pl.BlockSpec(
-                        (1, 1, q_len, _LANES), lambda b, n, *_: (b, n, 0, 0)
-                    ),
-                ],
+                in_specs=[full, full, full, sq, full, full],
                 out_specs=[full, full, full],
             ),
             out_shape=[
@@ -504,11 +566,12 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
-        )(seed, q, k, v, bias, o, do, lse)
+        )(seed, q, k, v, probs, o, do)
         dbias = jnp.zeros_like(bias)
         dseed = np.zeros(seed.shape, jax.dtypes.float0)
         return dq, dk, dv, dbias, dseed
 
+    lse = lse_or_probs
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # [B, N, S]

@@ -171,3 +171,22 @@ def test_flash_multiblock_grad_matches_reference(causal):
             np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
             err_msg=f"multi-block d{name} (causal={causal})",
         )
+
+
+def test_flash_fully_masked_row_stays_finite():
+    """A fully-padded sample (all-zero mask row) must give finite outputs
+    and gradients in the single-block (save-probs) path — the row max floor
+    prevents exp(-inf - -inf) NaNs."""
+    q, k, v = _qkv(seed=8)
+    mask = np.ones((2, 32), np.int32)
+    mask[1, :] = 0  # entire sample masked out
+    bias = make_attention_bias(jnp.asarray(mask))
+
+    def loss(q):
+        return jnp.sum(flash_attention(q, k, v, bias) ** 2)
+
+    with pltpu.force_tpu_interpret_mode():
+        out = flash_attention(q, k, v, bias)
+        g = jax.grad(loss)(q)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(g)).all()
