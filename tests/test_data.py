@@ -128,3 +128,38 @@ def test_multihost_slicing_partitions_batch():
     assert stacked.shape == (4, 2, 2)
     all_rows = stacked.transpose(1, 0, 2).reshape(-1)
     assert len(set(all_rows.tolist())) == 16  # disjoint cover of global batch
+
+
+def test_wordpiece_tokenizer_greedy_longest_match(tmp_path):
+    """WordPiece semantics over a tiny vocab: longest-match-first, ##
+    continuations, [UNK] for unmatchable words, special-token ids read from
+    the vocab (the reference's AutoTokenizer contract, owned in-repo)."""
+    from pytorch_distributed_training_tpu.data.tokenizer import (
+        WordPieceTokenizer,
+    )
+
+    vocab = [
+        "[PAD]", "[UNK]", "[CLS]", "[SEP]",
+        "un", "##aff", "##able", "##ffable", "aff", "able", "run", "##ning",
+    ]
+    vp = tmp_path / "vocab.txt"
+    vp.write_text("\n".join(vocab) + "\n")
+    tok = WordPieceTokenizer(str(vp))
+
+    assert tok.pad_id == 0 and tok.unk_id == 1
+    assert tok.cls_id == 2 and tok.sep_id == 3
+
+    ids = {t: i for i, t in enumerate(vocab)}
+    # greedy longest-first: "unffable" -> un + ##ffable (not un + ##aff...)
+    assert tok.word_ids("unffable") == [ids["un"], ids["##ffable"]]
+    # multi-piece continuation
+    assert tok.word_ids("unaffable") == [
+        ids["un"], ids["##aff"], ids["##able"]
+    ]
+    assert tok.word_ids("running") == [ids["run"], ids["##ning"]]
+    # no decomposition -> single [UNK] for the whole word
+    assert tok.word_ids("xyzzy") == [tok.unk_id]
+    # whole-text path splits on words/punct
+    assert tok.text_ids("running unffable") == [
+        ids["run"], ids["##ning"], ids["un"], ids["##ffable"]
+    ]

@@ -139,6 +139,22 @@ def test_loss_decreases_single_device():
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
 
 
+def _place_train_batch(mesh, batch):
+    """Place an [accum, micro, ...] batch with the ONE production layout
+    (mesh.TRAIN_BATCH_PSPEC) — shared by the dp/fsdp/tp parity tests so a
+    layout-contract change can't silently diverge from these tests."""
+    from jax.sharding import NamedSharding
+
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.asarray(x), NamedSharding(mesh, TRAIN_BATCH_PSPEC)
+        ),
+        batch,
+    )
+
+
 def test_dp8_matches_single_device(eight_devices):
     """The implicit claim of the reference's two scripts — distributed and
     single-device training compute the same thing — made explicit
@@ -158,17 +174,7 @@ def test_dp8_matches_single_device(eight_devices):
     step_dp = make_train_step(
         grad_accum_steps=2, mesh=mesh, state_shardings=shardings
     )
-    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
-    # microbatch-axis-first layout: dim0 accum (replicated), dim1 sharded —
-    # make_global_batch shards dim0, so place batch manually here.
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    gbatch = jax.tree.map(
-        lambda x: jax.device_put(
-            jnp.asarray(x), NamedSharding(mesh, P(None, ("data", "fsdp")))
-        ),
-        batch,
-    )
-    s2, m2 = step_dp(s_dp, gbatch)
+    s2, m2 = step_dp(s_dp, _place_train_batch(mesh, batch))
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=2e-5)
     a = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s1.params)])
     b = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s2.params)])
@@ -198,16 +204,44 @@ def test_fsdp_shards_params_and_matches(eight_devices):
             assert sharded, f"no param got fsdp-sharded: {specs}"
         step = make_train_step(grad_accum_steps=2, mesh=mesh,
                                state_shardings=shardings)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        gbatch = jax.tree.map(
-            lambda x: jax.device_put(
-                jnp.asarray(x), NamedSharding(mesh, P(None, ("data", "fsdp")))
-            ),
-            batch,
-        )
-        _, m = step(s, gbatch)
+        _, m = step(s, _place_train_batch(mesh, batch))
         results[name] = float(m["loss"])
     np.testing.assert_allclose(results["dp"], results["fsdp"], rtol=2e-5)
+
+
+def test_tp_shards_matmuls_and_matches(eight_devices):
+    """Tensor-parallel policy: attention/mlp kernels shard over the model
+    axis (Megatron-style), loss and updated params match pure DP."""
+    mesh_dp = build_mesh(MeshConfig(data=8))
+    mesh_tp = build_mesh(MeshConfig(data=2, model=4))
+    batch = make_batch(np.random.default_rng(5), 2, 16)
+
+    results = {}
+    for name, mesh, policy in [
+        ("dp", mesh_dp, ShardingPolicy()),
+        ("tp", mesh_tp, ShardingPolicy(tp=True)),
+    ]:
+        s = tiny_state()
+        shardings = state_shardings(s, policy, mesh)
+        s = shard_state(s, shardings)
+        if name == "tp":
+            specs = {
+                str(jax.tree_util.keystr(p)): x.sharding.spec
+                for p, x in jax.tree_util.tree_flatten_with_path(s.params)[0]
+            }
+            sharded = [k for k, v in specs.items() if "model" in str(v)]
+            assert sharded, f"no param got tp-sharded: {specs}"
+        step = make_train_step(grad_accum_steps=2, mesh=mesh,
+                               state_shardings=shardings)
+        s2, m = step(s, _place_train_batch(mesh, batch))
+        results[name] = (
+            float(m["loss"]),
+            np.concatenate(
+                [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(s2.params)]
+            ),
+        )
+    np.testing.assert_allclose(results["dp"][0], results["tp"][0], rtol=2e-5)
+    np.testing.assert_allclose(results["dp"][1], results["tp"][1], atol=3e-5)
 
 
 # ---------------------------------------------------------------- eval step
