@@ -17,6 +17,7 @@ apply unchanged.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_training_tpu.models.bert import (
@@ -148,6 +149,13 @@ class GPT2LMModel(nn.Module):
             param_dtype=_pdtype(cfg), name="ln_f",
         )(x)
         # Tied LM head: logits share the input embedding matrix (GPT-2
-        # convention), computed in fp32 for a stable softmax-CE.
-        logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        # convention). bf16 operands with fp32 MXU accumulation — the same
+        # policy as every other matmul; a full-fp32 vocab matmul runs at
+        # half MXU rate and the [B,S,V] logits dominate the LM step.
+        logits = jax.lax.dot_general(
+            x.astype(_dtype(cfg)),
+            wte.embedding.astype(_dtype(cfg)),
+            (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         return logits

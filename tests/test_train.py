@@ -140,18 +140,16 @@ def test_loss_decreases_single_device():
 
 
 def _place_train_batch(mesh, batch):
-    """Place an [accum, micro, ...] batch with the ONE production layout
-    (mesh.TRAIN_BATCH_PSPEC) — shared by the dp/fsdp/tp parity tests so a
-    layout-contract change can't silently diverge from these tests."""
-    from jax.sharding import NamedSharding
-
+    """Place an [accum, micro, ...] batch exactly as production does —
+    through comms.ingest.make_global_batch with the train pspec — so the
+    dp/fsdp/tp parity tests always exercise the real layout contract."""
+    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
     from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
 
-    return jax.tree.map(
-        lambda x: jax.device_put(
-            jnp.asarray(x), NamedSharding(mesh, TRAIN_BATCH_PSPEC)
-        ),
-        batch,
+    return make_global_batch(
+        mesh,
+        jax.tree.map(np.asarray, batch),
+        pspec=TRAIN_BATCH_PSPEC,
     )
 
 
