@@ -33,6 +33,7 @@ from pytorch_distributed_training_tpu.ops.attention import (
     dot_product_attention,
     make_attention_bias,
 )
+from pytorch_distributed_training_tpu.ops.dropout import Dropout
 from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
@@ -69,7 +70,9 @@ class BertEmbeddings(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          param_dtype=_pdtype(cfg), name="norm")(x)
         x = x.astype(_dtype(cfg))
-        return nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
+            x, deterministic=deterministic
+        )
 
 
 class BertSelfAttention(nn.Module):
@@ -94,6 +97,7 @@ class BertSelfAttention(nn.Module):
             dropout_rate=cfg.attention_dropout,
             deterministic=deterministic,
             causal=cfg.causal,
+            dropout_impl=cfg.dropout_impl,
         )
         return nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), name="out", **kw
@@ -116,7 +120,7 @@ class BertLayer(nn.Module):
         attn_out = BertSelfAttention(cfg, name="attention")(
             x, attention_bias, deterministic
         )
-        attn_out = nn.Dropout(cfg.hidden_dropout)(
+        attn_out = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
             attn_out, deterministic=deterministic
         )
         x = nn.LayerNorm(**ln, name="attention_norm")(x + attn_out)
@@ -125,7 +129,9 @@ class BertLayer(nn.Module):
         h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(x)
         h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
-        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
+            h, deterministic=deterministic
+        )
         x = nn.LayerNorm(**ln, name="mlp_norm")(x + h)
         return x.astype(_dtype(cfg))
 
@@ -179,7 +185,9 @@ def pool_cls(cfg: ModelConfig, x, deterministic):
     identically to the respective HF heads."""
     cls = x[:, 0]
     if cfg.roberta_style:
-        cls = nn.Dropout(cfg.hidden_dropout)(cls, deterministic=deterministic)
+        cls = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
+            cls, deterministic=deterministic
+        )
     pooled = nn.Dense(
         cfg.hidden_size, dtype=x.dtype, param_dtype=_pdtype(cfg),
         kernel_init=nn.initializers.normal(stddev=0.02), name="pooler",
@@ -189,7 +197,9 @@ def pool_cls(cfg: ModelConfig, x, deterministic):
 
 def classify(cfg: ModelConfig, pooled, deterministic):
     """dropout → fp32 dense('classifier') → logits, shared by all heads."""
-    pooled = nn.Dropout(cfg.hidden_dropout)(pooled, deterministic=deterministic)
+    pooled = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
+        pooled, deterministic=deterministic
+    )
     return nn.Dense(
         cfg.num_labels, dtype=jnp.float32, param_dtype=_pdtype(cfg),
         kernel_init=nn.initializers.normal(stddev=0.02), name="classifier",

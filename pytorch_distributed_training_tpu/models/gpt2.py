@@ -26,6 +26,7 @@ from pytorch_distributed_training_tpu.models.bert import (
     _pdtype,
 )
 from pytorch_distributed_training_tpu.ops.attention import make_attention_bias
+from pytorch_distributed_training_tpu.ops.dropout import Dropout
 from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
@@ -47,14 +48,14 @@ class GPT2Block(nn.Module):
         h = BertSelfAttention(cfg, name="attention")(
             h, attention_bias, deterministic
         )
-        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(h, deterministic=deterministic)
         x = x + h
 
         h = nn.LayerNorm(**ln, name="ln_2")(x).astype(_dtype(cfg))
         h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(h)
         h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
         h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
-        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(h, deterministic=deterministic)
         return x + h
 
 
@@ -122,7 +123,7 @@ class GPT2LMModel(nn.Module):
             param_dtype=_pdtype(cfg), name="wpe",
         )
         x = wte(input_ids) + wpe(position_ids)
-        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        x = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(x, deterministic=deterministic)
 
         # padding bias (causal masking is applied inside attention via
         # cfg.causal; GPT-2 training batches are usually dense so

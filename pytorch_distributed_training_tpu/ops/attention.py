@@ -14,9 +14,12 @@ flash-attention kernel slot) so CP can be added later without core changes"):
   registered by ``ops.ring_attention``.
 
 All implementations share the signature
-``impl(q, k, v, bias, *, dropout_rng, dropout_rate, deterministic, causal)``
-with q/k/v shaped [batch, seq, heads, head_dim] and an additive fp32 bias
-broadcastable to [batch, heads, q_len, kv_len].
+``impl(q, k, v, bias, *, dropout_rng, dropout_rate, deterministic, causal,
+dropout_impl)`` with q/k/v shaped [batch, seq, heads, head_dim] and an
+additive fp32 bias broadcastable to [batch, heads, q_len, kv_len].
+``dropout_impl`` selects the probs-mask generator (ops/dropout.py) for the
+impls that generate masks in XLA; the Pallas flash kernel's in-kernel
+per-core PRNG is its own generator and ignores it.
 """
 
 from __future__ import annotations
@@ -25,6 +28,8 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.ops.dropout import raw_dropout
 
 ATTENTION_IMPLS: dict[str, Callable] = {}
 
@@ -68,6 +73,7 @@ def reference_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     causal: bool = False,
+    dropout_impl: str = "exact",
 ):
     """Plain einsum attention; softmax in fp32 regardless of input dtype."""
     head_dim = q.shape[-1]
@@ -82,8 +88,7 @@ def reference_attention(
         scores = scores + causal_bias(q.shape[-3], k.shape[-3])
     probs = jax.nn.softmax(scores, axis=-1)
     if not deterministic and dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+        probs = raw_dropout(probs, dropout_rate, dropout_rng, dropout_impl)
     probs = probs.astype(v.dtype)
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
@@ -99,6 +104,7 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     causal: bool = False,
+    dropout_impl: str = "exact",
 ):
     """Dispatch to the configured attention implementation."""
     if impl not in ATTENTION_IMPLS:
@@ -127,4 +133,5 @@ def dot_product_attention(
         dropout_rate=dropout_rate,
         deterministic=deterministic,
         causal=causal,
+        dropout_impl=dropout_impl,
     )

@@ -1,0 +1,85 @@
+"""Dropout as a first-class framework op with a selectable mask generator.
+
+The reference inherits torch's dropout inside HF BERT (reference
+test_data_parallelism.py:112) — mask generation there is a CUDA kernel. On
+TPU the mask generator is a real throughput lever: profiling bert-large
+(NOTES.md) showed mask bits competing with the matmuls for VPU cycles, so
+the generator is configurable per model (``ModelConfig.dropout_impl``):
+
+- ``"exact"``  — ``jax.random.bernoulli`` (uniform-fp32 compare), bit-exact
+  with flax ``nn.Dropout`` under the same key. The numerically conventional
+  default for parity runs.
+- ``"bits32"`` — compares raw 32-bit PRNG words against ``rate * 2^32``:
+  same 1/2^32 keep-probability granularity as a fp32-uniform compare (fp32
+  uniforms only carry 24 random bits), but skips the int→float conversion
+  so the mask fuses into its consumer as integer VPU ops.
+
+- ``"bits8"``  — one random *byte* per element (a uint32 word drives four
+  elements): quarter the PRNG volume of the fp32-uniform path. The keep
+  probability quantizes to 1/256 granularity (rate 0.1 → actual drop rate
+  26/256 ≈ 0.1016); the inverted-dropout scale uses the *actual* rate so
+  E[output] == input exactly. Statistically equivalent regularization,
+  cheapest masks — the throughput default would be this if the quantized
+  rate mattered less than bits32's exact rate.
+
+Both draw from the key's configured generator (rbg rides the TPU hardware
+PRNG; threefry2x32 gives the portable stream — ``TrainConfig.prng_impl``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+DROPOUT_IMPLS = ("exact", "bits32", "bits8")
+
+
+def raw_dropout(x, rate: float, rng, impl: str = "exact"):
+    """Apply inverted dropout (train mode) to ``x``. Scale is 1/(1-rate)."""
+    if rate <= 0.0:
+        return x
+    if rate >= 1.0:  # nn.Dropout contract: everything dropped, no inf scale
+        return jnp.zeros_like(x)
+    if impl == "exact":
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+    if impl == "bits32":
+        thresh = jnp.uint32(min(round(rate * (1 << 32)), (1 << 32) - 1))
+        bits = jax.random.bits(rng, x.shape, jnp.uint32)
+        scale = jnp.asarray(1.0 / (1.0 - rate), x.dtype)
+        return jnp.where(bits >= thresh, x * scale, jnp.zeros_like(x))
+    if impl == "bits8":
+        thresh_i = min(max(round(rate * 256), 1), 255)
+        actual_rate = thresh_i / 256.0  # scale by the rate actually applied
+        if x.shape[-1] % 4 == 0:
+            words = jax.random.bits(
+                rng, (*x.shape[:-1], x.shape[-1] // 4), jnp.uint32
+            )
+            bits = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(
+                x.shape
+            )
+        else:
+            bits = jax.random.bits(rng, x.shape, jnp.uint8)
+        scale = jnp.asarray(1.0 / (1.0 - actual_rate), x.dtype)
+        return jnp.where(
+            bits >= jnp.uint8(thresh_i), x * scale, jnp.zeros_like(x)
+        )
+    raise ValueError(f"unknown dropout impl {impl!r}; have {DROPOUT_IMPLS}")
+
+
+class Dropout(nn.Module):
+    """Drop-in for ``nn.Dropout`` with the framework's mask generator.
+
+    Same contract: rng collection ``"dropout"``, ``deterministic=True`` (or
+    rate 0) is the identity.
+    """
+
+    rate: float
+    impl: str = "exact"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        if deterministic or self.rate <= 0.0:
+            return x
+        return raw_dropout(x, self.rate, self.make_rng("dropout"), self.impl)

@@ -733,6 +733,7 @@ def flash_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     causal: bool = False,
+    dropout_impl: str = "exact",  # in-kernel per-core PRNG; generator n/a
 ):
     """Adapter matching the swappable-attention signature (ops/attention.py).
 
@@ -760,6 +761,7 @@ def flash_attention(
             q, k, v, bias,
             dropout_rng=dropout_rng, dropout_rate=dropout_rate,
             deterministic=deterministic, causal=causal,
+            dropout_impl=dropout_impl,
         )
 
     rate = 0.0 if deterministic or dropout_rng is None else dropout_rate

@@ -52,12 +52,13 @@ from pytorch_distributed_training_tpu.ops.attention import (
     reference_attention,
     register_attention,
 )
+from pytorch_distributed_training_tpu.ops.dropout import raw_dropout
 
 _NEG_INF = -1e30
 
 
 def _local_block(q, k, v, bias, *, scale, q_offset, kv_offset, causal,
-                 dropout_rng, dropout_rate):
+                 dropout_rng, dropout_rate, dropout_impl):
     """One (local Q) x (one ring hop's K/V) block: scores + online-softmax
     partials. Shapes: q [B, Sq, N, D]; k/v [B, Skv, N, D];
     bias [B, 1, 1, Skv]. Returns (m, l, pv): running-max [B, N, Sq],
@@ -76,8 +77,7 @@ def _local_block(q, k, v, bias, *, scale, q_offset, kv_offset, causal,
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
     if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
-        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        p = raw_dropout(p, dropout_rate, dropout_rng, dropout_impl)
     pv = jnp.einsum(
         "bnst,btnd->bsnd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
@@ -86,7 +86,7 @@ def _local_block(q, k, v, bias, *, scale, q_offset, kv_offset, causal,
 
 
 def _ring_shard(q, k, v, bias, *, scale, n_shards, causal, dropout_rng,
-                dropout_rate, axis_name):
+                dropout_rate, dropout_impl, axis_name):
     """Per-shard body under shard_map: local Q stays, K/V/bias ring-hop."""
     my = jax.lax.axis_index(axis_name)
     seq_local = q.shape[1]
@@ -113,6 +113,7 @@ def _ring_shard(q, k, v, bias, *, scale, n_shards, causal, dropout_rng,
             causal=causal,
             dropout_rng=step_rng,
             dropout_rate=dropout_rate,
+            dropout_impl=dropout_impl,
         )
         m_new = jnp.maximum(m_run, m_j)
         alpha = jnp.exp(m_run - m_new)
@@ -146,6 +147,7 @@ def ring_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     causal: bool = False,
+    dropout_impl: str = "exact",
 ):
     """Sequence-parallel attention over the mesh ``seq`` axis.
 
@@ -165,6 +167,7 @@ def ring_attention(
             q, k, v, bias,
             dropout_rng=dropout_rng, dropout_rate=dropout_rate,
             deterministic=deterministic, causal=causal,
+            dropout_impl=dropout_impl,
         )
 
     scale = q.shape[-1] ** -0.5
@@ -186,6 +189,7 @@ def ring_attention(
         n_shards=n_shards,
         causal=causal,
         dropout_rate=rate,
+        dropout_impl=dropout_impl,
         axis_name=AXIS_SEQ,
     )
     fn = jax.shard_map(

@@ -174,6 +174,7 @@ class Trainer:
             mesh=self.mesh,
             state_shardings=self.shardings,
             objective=self.objective,
+            accum_dtype=train_config.grad_accum_dtype,
         )
         self.eval_step = make_eval_step(
             mesh=self.mesh, state_shardings=self.shardings,

@@ -20,8 +20,11 @@ compiled functions:
   (fixing the reference's uneven-last-batch gather skew, SURVEY.md §2c-6)
   and nothing bigger than a handful of scalars crosses device→host.
 
-Loss is computed in fp32 off bf16 activations; gradients accumulate in fp32.
-Jit donates ``state`` so params/optimizer state update in place in HBM.
+Loss is computed in fp32 off bf16 activations; gradients accumulate in fp32
+by default (``accum_dtype`` — TrainConfig.grad_accum_dtype — can trade carry
+bandwidth for bf16 rounding in the microbatch sum; the optimizer update is
+fp32 either way). Jit donates ``state`` so params/optimizer state update in
+place in HBM.
 """
 
 from __future__ import annotations
@@ -112,6 +115,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     state_shardings=None,
     objective: str = "classification",
+    accum_dtype: str = "float32",
 ) -> Callable:
     """Build the jitted train step.
 
@@ -123,6 +127,7 @@ def make_train_step(
     """
 
     forward_loss = _LOSS_FNS[objective]
+    acc_dtype = jnp.dtype(accum_dtype)
 
     def train_step(state: TrainState, batch):
         base_rng = jax.random.fold_in(state.dropout_rng, state.step)
@@ -137,19 +142,22 @@ def make_train_step(
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
             grads = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
             )
             return (grads, (loss_acc[0] + loss, loss_acc[1] + 1.0)), None
 
         zero_grads = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            lambda p: jnp.zeros(p.shape, acc_dtype), state.params
         )
         (grads, (loss_sum, _)), _ = jax.lax.scan(
             micro_grads,
             (zero_grads, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
             batch,
         )
-        grads = jax.tree.map(lambda g: g / grad_accum_steps, grads)
+        # optimizer math is always fp32 regardless of the carry dtype
+        grads = jax.tree.map(
+            lambda g: g.astype(jnp.float32) / grad_accum_steps, grads
+        )
         new_state = state.apply_gradients(grads)
         metrics = {
             "loss": loss_sum / grad_accum_steps,

@@ -99,6 +99,11 @@ class ModelConfig:
     # "reference" (XLA einsum) | "flash" (Pallas kernel, ops/flash_attention)
     # | "ring" (sequence-parallel, ops/ring_attention)
     attention_impl: str = "reference"
+    # Dropout mask generator (ops/dropout.py): "bits32" compares raw PRNG
+    # words (no int->float conversion; same 1/2^32 granularity — fp32
+    # uniforms only carry 24 random bits); "exact" is bit-exact with flax
+    # nn.Dropout under the same key.
+    dropout_impl: str = "bits32"
     # dtype policy: params fp32, compute bf16 (TPU-native replacement for the
     # reference's fp16 AMP, test_data_parallelism.py:55; SURVEY.md §2b).
     compute_dtype: str = "bfloat16"
@@ -207,6 +212,11 @@ class TrainConfig:
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     bf16: bool = True
+    # Gradient-accumulation carry dtype: "float32" (default) or "bfloat16"
+    # (halves the scan-carry HBM traffic; microbatch gradients round to bf16
+    # before summing — AdamW's sqrt(v) normalization makes fine-tuning
+    # insensitive to this, but fp32 is the conservative default).
+    grad_accum_dtype: str = "float32"
     max_seq_length: int = 128  # the reference's own TPU pad branch (:96-98)
     # 0 = use the full dataset; >0 truncates (fast smoke/integration runs)
     train_size: int = 0

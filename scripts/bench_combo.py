@@ -1,0 +1,84 @@
+"""E2E step-time for dropout_impl x grad_accum_dtype combos (bert-large MRPC)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.models import BertForSequenceClassification
+from pytorch_distributed_training_tpu.parallel import ShardingPolicy, state_shardings
+from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
+from pytorch_distributed_training_tpu.train.state import create_train_state
+from pytorch_distributed_training_tpu.train.step import make_train_step
+from pytorch_distributed_training_tpu.utils.config import TrainConfig, model_preset
+
+GLOBAL, SEQ, ITERS = 96, 128, 20
+
+
+def batch_for(accum, mesh):
+    import numpy as np
+    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+
+    rng = np.random.default_rng(0)
+    micro = GLOBAL // accum
+    b = {
+        "input_ids": rng.integers(0, 28996, (accum, micro, SEQ)).astype(np.int32),
+        "attention_mask": np.ones((accum, micro, SEQ), np.int32),
+        "token_type_ids": np.zeros((accum, micro, SEQ), np.int32),
+        "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
+    }
+    return make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
+
+
+def run(dropout_impl, accum_dtype, micro=32):
+    mesh = build_mesh()
+    mcfg = model_preset("bert-large-cased", dropout_impl=dropout_impl)
+    model = BertForSequenceClassification(mcfg)
+    tcfg = TrainConfig(global_batch_size=GLOBAL, micro_batch_size=micro)
+    tx, _ = adamw_with_schedule(tcfg, total_steps=1000)
+    example = {
+        "input_ids": jnp.ones((2, SEQ), jnp.int32),
+        "attention_mask": jnp.ones((2, SEQ), jnp.int32),
+        "token_type_ids": jnp.zeros((2, SEQ), jnp.int32),
+    }
+    state = create_train_state(model, tx, jax.random.key(42, impl="rbg"), example)
+    shardings = state_shardings(state, ShardingPolicy(), mesh)
+    state = shard_state(state, shardings)
+    accum = tcfg.grad_accum_steps
+    step = make_train_step(
+        grad_accum_steps=accum, mesh=mesh, state_shardings=shardings,
+        objective="classification", accum_dtype=accum_dtype,
+    )
+    batch = batch_for(accum, mesh)
+    state, m = step(state, batch)
+    jax.block_until_ready(state.params)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            state, m = step(state, batch)
+        _ = float(jax.device_get(m["loss"]))
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    print(
+        f"dropout={dropout_impl:7s} acc={accum_dtype:9s} micro={micro:3d}"
+        f"  {best*1e3:7.2f} ms/step  {GLOBAL/best:6.1f} samples/s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    combos = [
+        ("bits32", "float32", 32),
+        ("bits8", "float32", 32),
+        ("bits32", "bfloat16", 32),
+        ("bits8", "bfloat16", 32),
+        ("bits8", "bfloat16", 48),
+        ("bits8", "bfloat16", 96),
+    ]
+    for d, a, m in combos:
+        run(d, a, m)

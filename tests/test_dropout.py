@@ -1,0 +1,85 @@
+"""Dropout-op tests: statistics, expectation preservation, flax parity.
+
+The reference inherits torch dropout inside HF BERT (reference
+test_data_parallelism.py:112); this framework owns the op (ops/dropout.py)
+with selectable mask generators, so each generator's distributional contract
+is pinned here.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.ops.dropout import (
+    DROPOUT_IMPLS,
+    Dropout,
+    raw_dropout,
+)
+
+RATE = 0.1
+
+
+@pytest.mark.parametrize("impl", DROPOUT_IMPLS)
+def test_keep_rate_and_expectation(impl):
+    """Empirical drop rate matches the impl's nominal rate and E[out] == x
+    (inverted dropout scales by exactly the applied rate)."""
+    x = jnp.ones((64, 1024), jnp.float32)
+    rng = jax.random.key(0)
+    out = raw_dropout(x, RATE, rng, impl)
+    dropped = float((out == 0).mean())
+    # bits8 quantizes the rate to 26/256; all within ±1% absolute here
+    expected = 26 / 256 if impl == "bits8" else RATE
+    assert abs(dropped - expected) < 0.01, (impl, dropped)
+    # kept values are scaled by 1/(1-applied_rate) -> empirical mean ~= 1
+    assert abs(float(out.mean()) - 1.0) < 0.02, (impl, float(out.mean()))
+
+
+@pytest.mark.parametrize("impl", DROPOUT_IMPLS)
+def test_deterministic_under_same_key(impl):
+    x = jax.random.normal(jax.random.key(1), (32, 257))  # odd minor dim
+    rng = jax.random.key(2)
+    a = raw_dropout(x, RATE, rng, impl)
+    b = raw_dropout(x, RATE, rng, impl)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = raw_dropout(x, RATE, jax.random.key(3), impl)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_exact_matches_flax_dropout():
+    """The module with impl="exact" is bit-identical to ``nn.Dropout`` under
+    the same rng collection (both resolve the key via ``make_rng`` from the
+    same module path)."""
+    x = jax.random.normal(jax.random.key(4), (16, 128))
+    rngs = {"dropout": jax.random.key(5)}
+    ours = Dropout(RATE, "exact").apply({}, x, deterministic=False, rngs=rngs)
+    theirs = nn.Dropout(RATE, deterministic=False).apply({}, x, rngs=rngs)
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(theirs))
+
+
+def test_module_deterministic_is_identity():
+    x = jax.random.normal(jax.random.key(6), (4, 8))
+    for impl in DROPOUT_IMPLS:
+        out = Dropout(RATE, impl).apply({}, x, deterministic=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    out = Dropout(0.0, "bits32").apply(
+        {}, x, deterministic=False, rngs={"dropout": jax.random.key(7)}
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_unknown_impl_raises():
+    with pytest.raises(ValueError, match="unknown dropout impl"):
+        raw_dropout(jnp.ones((4, 4)), RATE, jax.random.key(0), "nope")
+
+
+def test_bits8_padded_minor_dim():
+    """bits8's word->byte bitcast path (minor dim % 4 == 0) and the fallback
+    path (odd minor dim) both honor the quantized rate."""
+    rng = jax.random.key(8)
+    for shape in ((8, 1024), (8, 1023)):
+        x = jnp.ones(shape, jnp.bfloat16)
+        out = raw_dropout(x, RATE, rng, "bits8")
+        dropped = float((out == 0).mean())
+        assert abs(dropped - 26 / 256) < 0.02, (shape, dropped)
