@@ -88,8 +88,19 @@ def reference_attention(
         scores = scores + causal_bias(q.shape[-3], k.shape[-3])
     probs = jax.nn.softmax(scores, axis=-1)
     if not deterministic and dropout_rate > 0.0:
-        probs = raw_dropout(probs, dropout_rate, dropout_rng, dropout_impl)
-    probs = probs.astype(v.dtype)
+        if dropout_impl == "exact":
+            # flax-parity order: mask the fp32 probs, then cast
+            probs = raw_dropout(probs, dropout_rate, dropout_rng, dropout_impl)
+            probs = probs.astype(v.dtype)
+        else:
+            # bf16-policy order: cast first so the dropout mask residual is
+            # half-width (a custom-vjp softmax that also rounds the probs
+            # residual to bf16 measured SLOWER — XLA's own fused softmax
+            # backward beats the hand-written ds formula; NOTES.md)
+            probs = probs.astype(v.dtype)
+            probs = raw_dropout(probs, dropout_rate, dropout_rng, dropout_impl)
+    else:
+        probs = probs.astype(v.dtype)
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 

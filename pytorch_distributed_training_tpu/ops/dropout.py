@@ -48,7 +48,19 @@ def raw_dropout(x, rate: float, rng, impl: str = "exact"):
         thresh = jnp.uint32(min(round(rate * (1 << 32)), (1 << 32) - 1))
         bits = jax.random.bits(rng, x.shape, jnp.uint32)
         scale = jnp.asarray(1.0 / (1.0 - rate), x.dtype)
-        return jnp.where(bits >= thresh, x * scale, jnp.zeros_like(x))
+        # multiply-by-mask-scale (not where(bits, x, 0)): the multiply's
+        # backward residual is the small x-dtype mask tensor, so XLA saves
+        # that instead of the 4-byte random words (measured: the u32
+        # residual copies were 3.6 ms/step on bert-large). IEEE note: a
+        # non-finite x stays non-finite at dropped positions (NaN*0=NaN)
+        # instead of being quenched to 0 like a select would — deliberate:
+        # masking a NaN in 10% of positions only hides real numeric bugs
+        # (--debug-nans is the detection tool), and finite inputs are
+        # bit-identical to the select form.
+        mask_scale = jnp.where(
+            bits >= thresh, scale, jnp.zeros((), x.dtype)
+        )
+        return x * mask_scale
     if impl == "bits8":
         thresh_i = min(max(round(rate * 256), 1), 255)
         actual_rate = thresh_i / 256.0  # scale by the rate actually applied
@@ -62,9 +74,11 @@ def raw_dropout(x, rate: float, rng, impl: str = "exact"):
         else:
             bits = jax.random.bits(rng, x.shape, jnp.uint8)
         scale = jnp.asarray(1.0 / (1.0 - actual_rate), x.dtype)
-        return jnp.where(
-            bits >= jnp.uint8(thresh_i), x * scale, jnp.zeros_like(x)
+        # same multiply form (and IEEE semantics) as bits32
+        mask_scale = jnp.where(
+            bits >= jnp.uint8(thresh_i), scale, jnp.zeros((), x.dtype)
         )
+        return x * mask_scale
     raise ValueError(f"unknown dropout impl {impl!r}; have {DROPOUT_IMPLS}")
 
 

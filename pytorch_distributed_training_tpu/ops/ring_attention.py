@@ -75,11 +75,13 @@ def _local_block(q, k, v, bias, *, scale, q_offset, kv_offset, causal,
         s = s + jnp.where(k_pos <= q_pos, 0.0, _NEG_INF)[None, None]
     m = jnp.max(s, axis=-1)  # [B, N, Sq]
     p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
+    l = jnp.sum(p, axis=-1)  # denominator from the UNDROPPED fp32 p
+    p = p.astype(v.dtype)  # cast before dropout: half-width mask residual,
+    # same ordering as reference_attention's bf16-policy path
     if dropout_rate > 0.0:
         p = raw_dropout(p, dropout_rate, dropout_rng, dropout_impl)
     pv = jnp.einsum(
-        "bnst,btnd->bsnd", p.astype(v.dtype), v,
+        "bnst,btnd->bsnd", p, v,
         preferred_element_type=jnp.float32,
     )
     return m, l, pv

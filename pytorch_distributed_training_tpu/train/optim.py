@@ -57,6 +57,11 @@ def adamw_with_schedule(
             b2=config.adam_b2,
             eps=config.adam_eps,
             weight_decay=config.weight_decay,
+            # first-moment dtype: bf16 halves the m read+write traffic in
+            # the fused update (optax upcasts for the math); fp32 default.
+            # The second moment stays fp32 always — sqrt(v)+eps is the
+            # precision-critical denominator.
+            mu_dtype=config.adam_mu_dtype,
         )
     )
     return optax.chain(*components), schedule

@@ -211,6 +211,9 @@ class TrainConfig:
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
+    # AdamW first-moment (m) storage dtype; "bfloat16" halves optimizer-state
+    # traffic for m (v stays fp32 — it sits under the sqrt and needs range)
+    adam_mu_dtype: str = "float32"
     bf16: bool = True
     # Gradient-accumulation carry dtype: "float32" (default) or "bfloat16"
     # (halves the scan-carry HBM traffic; microbatch gradients round to bf16
