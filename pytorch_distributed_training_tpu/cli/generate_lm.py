@@ -1,0 +1,116 @@
+"""Text-generation entry point for the causal-LM family (KV-cache decode).
+
+The reference repo has no inference side at all; this completes the GPT-2
+family (models/gpt2.py + models/generate.py) with a CLI:
+
+    python -m pytorch_distributed_training_tpu.cli.generate_lm \
+        --model gpt2-medium --checkpoint-dir /ckpts/run1 \
+        --vocab encoder.json --merges merges.txt \
+        --prompt "The quick brown" --max-new-tokens 32 --temperature 0.8
+
+Weights come from a framework checkpoint (``--checkpoint-dir``, the trainer's
+save format), an HF GPT-2 checkpoint directory (``--hf-checkpoint``), or
+random init (demo mode — still useful for smoke-testing the decode path).
+Tokenization uses the in-repo byte-level BPE when ``--vocab``/``--merges``
+are given, else the lossless raw-byte fallback (data/bpe.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", default="gpt2-medium")
+    p.add_argument("--prompt", default="The quick brown fox")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 = sampling")
+    p.add_argument("--top-k", type=int, default=40)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="framework checkpoint directory (trainer format)")
+    p.add_argument("--hf-checkpoint", default=None,
+                   help="HF GPT-2 checkpoint directory (torch weights)")
+    p.add_argument("--vocab", default=None, help="encoder.json path")
+    p.add_argument("--merges", default=None, help="merges.txt path")
+    p.add_argument("--stop-at-eot", action=argparse.BooleanOptionalAction,
+                   default=True)
+    return p
+
+
+def main(argv=None) -> str:
+    args = build_parser().parse_args(argv)
+
+    from pytorch_distributed_training_tpu.data.bpe import (
+        ByteLevelBPETokenizer,
+        ByteTokenizer,
+    )
+    from pytorch_distributed_training_tpu.models.generate import generate
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+    from pytorch_distributed_training_tpu.utils.logging import log0
+
+    if args.vocab and args.merges:
+        tok = ByteLevelBPETokenizer(args.vocab, args.merges)
+    else:
+        log0("no --vocab/--merges: using raw-byte fallback tokenizer")
+        tok = ByteTokenizer()
+
+    mcfg = model_preset(args.model, scan_layers=False)
+    if not mcfg.causal:
+        raise SystemExit(f"--model {args.model} is not a causal preset")
+    if tok.vocab_size > mcfg.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+            f"{mcfg.vocab_size}"
+        )
+    model = GPT2LMModel(mcfg)
+
+    prompt_ids = np.asarray([tok.text_ids(args.prompt)], np.int32)
+    if prompt_ids.shape[1] == 0:
+        raise SystemExit("empty prompt after tokenization")
+
+    if args.hf_checkpoint:
+        from pytorch_distributed_training_tpu.models.hf_loader import (
+            load_gpt2_lm,
+        )
+
+        params = load_gpt2_lm(args.hf_checkpoint, mcfg)
+    elif args.checkpoint_dir:
+        from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+
+        params = ckpt.restore_params(args.checkpoint_dir)
+    else:
+        log0("no checkpoint given: generating from RANDOM weights (demo)")
+        params = model.init(
+            jax.random.key(args.seed),
+            np.ones((1, prompt_ids.shape[1]), np.int32),
+        )["params"]
+
+    out = generate(
+        model,
+        params,
+        prompt_ids,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        rng=jax.random.key(args.seed),
+        eot_id=getattr(tok, "eot_id", None) if args.stop_at_eot else None,
+    )
+    ids = np.asarray(out)[0, prompt_ids.shape[1]:]
+    if args.stop_at_eot and getattr(tok, "eot_id", None) is not None:
+        stops = np.where(ids == tok.eot_id)[0]
+        if len(stops):
+            ids = ids[: stops[0]]
+    text = tok.decode(ids)
+    print(args.prompt + text)
+    return text
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,15 @@
 from pytorch_distributed_training_tpu.data.pipeline import ShardedLoader
 from pytorch_distributed_training_tpu.data.glue import load_task_arrays
+from pytorch_distributed_training_tpu.data.bpe import (
+    ByteLevelBPETokenizer,
+    ByteTokenizer,
+    encode_lm_rows,
+)
 
-__all__ = ["ShardedLoader", "load_task_arrays"]
+__all__ = [
+    "ShardedLoader",
+    "load_task_arrays",
+    "ByteLevelBPETokenizer",
+    "ByteTokenizer",
+    "encode_lm_rows",
+]

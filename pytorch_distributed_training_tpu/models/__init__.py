@@ -5,9 +5,11 @@ from pytorch_distributed_training_tpu.models.bert import (
 from pytorch_distributed_training_tpu.models.branch import (
     BranchEnsembleClassifier,
 )
+from pytorch_distributed_training_tpu.models.generate import generate
 
 __all__ = [
     "BertEncoderModel",
     "BertForSequenceClassification",
     "BranchEnsembleClassifier",
+    "generate",
 ]

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_training_tpu.ops.attention import (
@@ -87,21 +88,80 @@ class BertSelfAttention(nn.Module):
         q = nn.DenseGeneral(heads_shape, axis=-1, name="query", **kw)(x)
         k = nn.DenseGeneral(heads_shape, axis=-1, name="key", **kw)(x)
         v = nn.DenseGeneral(heads_shape, axis=-1, name="value", **kw)(x)
-        dropout_rng = None
-        if not deterministic and cfg.attention_dropout > 0.0:
-            dropout_rng = self.make_rng("dropout")
-        out = dot_product_attention(
-            q, k, v, attention_bias,
-            impl=cfg.attention_impl,
-            dropout_rng=dropout_rng,
-            dropout_rate=cfg.attention_dropout,
-            deterministic=deterministic,
-            causal=cfg.causal,
-            dropout_impl=cfg.dropout_impl,
-        )
+        if cfg.decode:
+            out = self._cached_attend(q, k, v, attention_bias)
+        else:
+            dropout_rng = None
+            if not deterministic and cfg.attention_dropout > 0.0:
+                dropout_rng = self.make_rng("dropout")
+            out = dot_product_attention(
+                q, k, v, attention_bias,
+                impl=cfg.attention_impl,
+                dropout_rng=dropout_rng,
+                dropout_rate=cfg.attention_dropout,
+                deterministic=deterministic,
+                causal=cfg.causal,
+                dropout_impl=cfg.dropout_impl,
+            )
         return nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), name="out", **kw
         )(out)
+
+    def _cached_attend(self, q, k, v, attention_bias):
+        """Autoregressive attention over the KV cache (generation path).
+
+        Flax "cache" collection pattern: the cache buffers are created at
+        their FULL [batch, max_len, heads, head_dim] size during ``init``
+        (call the model once with a max_len-shaped dummy input), and every
+        subsequent ``apply(..., mutable=["cache"])`` writes the current
+        chunk at ``cache_index`` and attends causally over the filled
+        prefix. Works for multi-token prefill chunks and 1-token decode
+        steps alike. Deterministic (no dropout) — generation never trains.
+        """
+        cfg = self.config
+        if not cfg.causal:
+            raise ValueError("decode=True requires a causal model")
+        batch, chunk, heads, head_dim = q.shape
+        is_init = not self.has_variable("cache", "cached_key")
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros(k.shape, k.dtype),
+        )
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros(v.shape, v.dtype),
+        )
+        ci = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if is_init:
+            # init trace: buffers take the dummy input's (max_len) shape;
+            # attend output only fixes parameter shapes, values unused
+            return q
+        idx = ci.value
+        max_len = ck.value.shape[1]
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+        )
+        ci.value = idx + chunk
+        scale = head_dim ** -0.5
+        scores = jnp.einsum(
+            "bsnd,btnd->bnst", q, ck.value,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # causal-over-cache mask: key position t visible to chunk row i iff
+        # t <= idx + i (rows are global positions idx..idx+chunk-1)
+        q_pos = idx + jax.lax.broadcasted_iota(jnp.int32, (chunk, max_len), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (chunk, max_len), 1)
+        neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where((k_pos <= q_pos)[None, None], scores, neg)
+        if attention_bias is not None:
+            scores = scores + attention_bias.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.value.dtype)
+        return jnp.einsum("bnst,btnd->bsnd", probs, cv.value)
 
 
 class BertLayer(nn.Module):

@@ -1,0 +1,177 @@
+"""Autoregressive generation for the causal-LM family (KV-cache decode).
+
+The reference repo is fine-tuning-only — it never samples from a model. A
+complete framework needs the inference side of its decoder family, so this
+module provides jitted prefill+decode generation over the KV cache that
+``BertSelfAttention._cached_attend`` maintains (flax "cache" collection):
+
+- ONE forward over the whole prompt fills the cache (prefill), then a
+  ``lax.scan`` emits one token per step attending over the cache — O(L) per
+  new token instead of the O(L^2) full-recompute loop.
+- Greedy (temperature=0) or temperature/top-k sampling via
+  ``jax.random.categorical``.
+- Static shapes throughout (prompt length and max_new_tokens fix the cache
+  size), so the whole generate call is one compiled program — XLA-friendly
+  exactly like the train step.
+
+Prompt batches are right-padded. Each row's next-token distribution starts
+from its own last REAL prompt token (``prompt_lengths``), and pad positions
+are masked out of attention; continuations for every row are written at
+columns [prompt_len, prompt_len + max_new_tokens). Note the GPT-2 absolute
+position of generated tokens is the padded column index (the standard
+right-padding caveat — rows much shorter than the padded length see a
+positional gap; batch similar-length prompts together when that matters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits [B, V] -> token ids [B]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def generate(
+    model,
+    params,
+    prompt_ids: np.ndarray,
+    *,
+    max_new_tokens: int,
+    prompt_lengths: Optional[np.ndarray] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng=None,
+    eot_id: Optional[int] = None,
+):
+    """Generate continuations for a batch of right-padded prompts.
+
+    Args:
+        model: a ``GPT2LMModel`` (or config-compatible causal LM) with
+            ``scan_layers=False`` (the scanned trunk's stacked param layout
+            has no cache plumbing).
+        params: trained parameter pytree for ``model``.
+        prompt_ids: [batch, prompt_len] int32, right-padded.
+        max_new_tokens: tokens to append per row.
+        prompt_lengths: [batch] real prompt lengths; defaults to full rows.
+        temperature: 0 → greedy argmax; >0 → categorical sampling.
+        top_k: keep only the k highest logits before sampling (0 = all).
+        rng: jax PRNG key (required when temperature > 0).
+        eot_id: when set, a row that emits this token keeps emitting it
+            (frozen) for the rest of the scan.
+
+    Returns:
+        [batch, prompt_len + max_new_tokens] int32 — the padded prompts
+        with continuations in the trailing ``max_new_tokens`` columns.
+    """
+    cfg = model.config
+    if not cfg.causal:
+        raise ValueError("generate() needs a causal model")
+    if cfg.scan_layers:
+        raise ValueError(
+            "generate() supports scan_layers=False models (the scanned "
+            "trunk's stacked param layout has no cache plumbing yet)"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    batch, prompt_len = prompt_ids.shape
+    total_len = prompt_len + max_new_tokens
+    if total_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total_len} exceeds "
+            f"max_position_embeddings {cfg.max_position_embeddings}"
+        )
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((batch,), prompt_len, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    decode_model = type(model)(dataclasses.replace(cfg, decode=True))
+
+    # Cache buffers are sized by the init input: shape-infer the "cache"
+    # collection from an abstract init at total_len (eval_shape — no params
+    # are materialized) and allocate zeros per leaf.
+    cache_shapes = jax.eval_shape(
+        lambda: decode_model.init(
+            jax.random.key(0), jnp.ones((batch, total_len), jnp.int32)
+        )
+    )["cache"]
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+
+    @jax.jit
+    def run(params, cache, prompt_ids, prompt_lengths, rng):
+        out = jnp.zeros((batch, total_len), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, prompt_ids, (0, 0))
+        positions = jnp.arange(total_len, dtype=jnp.int32)[None, :]
+
+        def mask_upto(n_generated):
+            """Visibility over the full buffer: each row's real prompt
+            prefix plus the first ``n_generated`` generated positions."""
+            return (
+                (positions < prompt_lengths[:, None])
+                | (
+                    (positions >= prompt_len)
+                    & (positions < prompt_len + n_generated)
+                )
+            ).astype(jnp.int32)
+
+        # ---- prefill: one forward over the whole (padded) prompt
+        logits, vars_ = decode_model.apply(
+            {"params": params, "cache": cache},
+            prompt_ids,
+            mask_upto(0),
+            mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        # next token comes from each row's LAST REAL prompt position
+        last = jnp.take_along_axis(
+            logits, (prompt_lengths - 1)[:, None, None], axis=1
+        )[:, 0, :].astype(jnp.float32)
+
+        def step(carry, t):
+            cache, out, prev_logits, done, rng = carry
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(prev_logits, sub, temperature, top_k)
+            if eot_id is not None:
+                nxt = jnp.where(done, eot_id, nxt)
+                done = done | (nxt == eot_id)
+            out = jax.lax.dynamic_update_slice(
+                out.T, nxt[None, :], (prompt_len + t, 0)
+            ).T
+            logits, vars_ = decode_model.apply(
+                {"params": params, "cache": cache},
+                nxt[:, None],
+                mask_upto(t + 1),
+                mutable=["cache"],
+            )
+            return (
+                vars_["cache"], out, logits[:, 0, :].astype(jnp.float32),
+                done, rng,
+            ), None
+
+        done0 = jnp.zeros((batch,), bool)
+        (cache, out, _, _, _), _ = jax.lax.scan(
+            step,
+            (cache, out, last, done0, rng),
+            jnp.arange(max_new_tokens, dtype=jnp.int32),
+        )
+        return out
+
+    return run(params, cache, prompt_ids, prompt_lengths, rng)

@@ -109,9 +109,23 @@ class GPT2LMModel(nn.Module):
                 f"max_position_embeddings for long-context runs"
             )
         if position_ids is None:
-            position_ids = jnp.broadcast_to(
-                jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
-            )
+            if cfg.decode:
+                # generation: positions continue from the cached index
+                # (same flax "cache" pattern as the attention KV buffers)
+                is_init = not self.has_variable("cache", "pos_index")
+                pi = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                offset = jnp.zeros((), jnp.int32) if is_init else pi.value
+                if not is_init:
+                    pi.value = offset + seq
+                position_ids = offset + jnp.broadcast_to(
+                    jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
+                )
+            else:
+                position_ids = jnp.broadcast_to(
+                    jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq)
+                )
         embed_init = nn.initializers.normal(stddev=0.02)
         wte = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, embedding_init=embed_init,

@@ -130,6 +130,22 @@ def latest_step(directory: str) -> Optional[int]:
         return mngr.latest_step()
 
 
+def restore_params(directory: str, *, step: Optional[int] = None):
+    """Restore ONLY the parameter pytree from a training checkpoint.
+
+    The inference-side loader (cli/generate_lm.py): no optimizer state or
+    step counter is reconstructed, and leaves come back as host arrays for
+    the caller to place (single-chip inference just feeds them to apply)."""
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(directory) as mngr:
+        step = mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        restored = mngr.restore(step)
+    log0(f"params restored: {directory}/{step}")
+    return dict(restored)["params"]
+
+
 def restore_checkpoint(
     directory: str, state: TrainState, *, step: Optional[int] = None
 ) -> TrainState:

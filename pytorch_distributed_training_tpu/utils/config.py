@@ -110,6 +110,11 @@ class ModelConfig:
     param_dtype: str = "float32"
     # causal decoder flag (GPT-2 family)
     causal: bool = False
+    # Autoregressive-decode mode (generation): attention modules maintain a
+    # KV cache in the flax "cache" variable collection and attend over it;
+    # position ids advance from the cached index (models/generate.py). Only
+    # meaningful with causal=True; training paths leave this False.
+    decode: bool = False
     # RoBERTa-style embeddings (pad-offset position ids, no token types)
     roberta_style: bool = False
     pad_token_id: int = 0

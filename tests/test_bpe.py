@@ -1,0 +1,109 @@
+"""Byte-level BPE tests: parity with transformers' GPT2Tokenizer over the
+same vocab/merges files (built as a tiny fixture — no network), plus the
+byte-alphabet invariants and the offline byte fallback.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.data.bpe import (
+    ByteLevelBPETokenizer,
+    ByteTokenizer,
+    bytes_to_unicode,
+    encode_lm_rows,
+)
+
+SAMPLES = [
+    "Hello world!",
+    "The quick brown fox jumps over the lazy dog.",
+    "it's we've they'll I'm don't",
+    "  spaced   out\ttabs\nnewlines  ",
+    "numbers 12345 and mixed a1b2",
+    "unicode: café naïve über — dash",
+    "",
+]
+
+
+def _byte_vocab_fixture(tmp_path):
+    """A real (if tiny) GPT-2-format vocab: all 256 byte symbols + a few
+    merges + <|endoftext|>. Every text is encodable (byte fallback through
+    the alphabet), and the merges exercise the rank loop."""
+    b2u = bytes_to_unicode()
+    symbols = [b2u[i] for i in range(256)]
+    merges = [
+        (b2u[ord("t")], b2u[ord("h")]),             # th
+        (b2u[ord("t")] + b2u[ord("h")], b2u[ord("e")]),  # the
+        (b2u[ord(" ")], b2u[ord("t")] + b2u[ord("h")] + b2u[ord("e")]),  # Ġthe
+        (b2u[ord("e")], b2u[ord("r")]),             # er
+        (b2u[ord("o")], b2u[ord("v")]),             # ov
+        (b2u[ord("o")] + b2u[ord("v")], b2u[ord("e")] + b2u[ord("r")]),  # over
+    ]
+    vocab = {s: i for i, s in enumerate(symbols)}
+    for a, b in merges:
+        vocab.setdefault(a + b, len(vocab))
+    vocab["<|endoftext|>"] = len(vocab)
+    vp = tmp_path / "encoder.json"
+    mp = tmp_path / "merges.txt"
+    vp.write_text(json.dumps(vocab), encoding="utf-8")
+    mp.write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+        encoding="utf-8",
+    )
+    return str(vp), str(mp)
+
+
+def test_bytes_to_unicode_invariants():
+    m = bytes_to_unicode()
+    assert len(m) == 256 and len(set(m.values())) == 256
+    assert m[ord("A")] == "A"  # printable ascii maps to itself
+
+
+def test_parity_with_transformers(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    vp, mp = _byte_vocab_fixture(tmp_path)
+    ours = ByteLevelBPETokenizer(vp, mp)
+    theirs = transformers.GPT2Tokenizer(vocab_file=vp, merges_file=mp)
+    for text in SAMPLES:
+        assert ours.text_ids(text) == theirs.encode(text), text
+
+
+def test_roundtrip_decode(tmp_path):
+    vp, mp = _byte_vocab_fixture(tmp_path)
+    tok = ByteLevelBPETokenizer(vp, mp)
+    for text in SAMPLES:
+        assert tok.decode(tok.text_ids(text)) == text
+
+
+def test_merges_actually_merge(tmp_path):
+    vp, mp = _byte_vocab_fixture(tmp_path)
+    tok = ByteLevelBPETokenizer(vp, mp)
+    ids = tok.text_ids("the theater")
+    # "the" must encode via the Ġthe/the merges, not byte-by-byte
+    assert len(ids) < len("the theater")
+
+
+def test_byte_fallback_roundtrip():
+    tok = ByteTokenizer()
+    for text in SAMPLES:
+        ids = tok.text_ids(text)
+        assert all(0 <= i < 256 for i in ids)
+        assert tok.decode(ids) == text
+
+
+def test_encode_lm_rows_shapes(tmp_path):
+    vp, mp = _byte_vocab_fixture(tmp_path)
+    tok = ByteLevelBPETokenizer(vp, mp)
+    out = encode_lm_rows(tok, ["the fox", "a much longer text " * 20], 16)
+    assert out["input_ids"].shape == (2, 16)
+    assert out["attention_mask"].shape == (2, 16)
+    # row 0: ends with eot, padded with pad_id, mask matches
+    n0 = out["attention_mask"][0].sum()
+    assert out["input_ids"][0, n0 - 1] == tok.eot_id
+    assert (out["input_ids"][0, n0:] == tok.pad_id).all()
+    # row 1: truncated to full length
+    assert out["attention_mask"][1].sum() == 16
+    np.testing.assert_array_equal(
+        out["input_ids"][1], encode_lm_rows(tok, ["a much longer text " * 20], 16)["input_ids"][0]
+    )
