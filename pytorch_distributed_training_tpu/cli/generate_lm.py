@@ -84,7 +84,12 @@ def main(argv=None) -> str:
     elif args.checkpoint_dir:
         from pytorch_distributed_training_tpu.train import checkpoint as ckpt
 
-        params = ckpt.restore_params(args.checkpoint_dir)
+        abstract = jax.eval_shape(
+            lambda: model.init(
+                jax.random.key(0), np.ones((1, 8), np.int32)
+            )
+        )["params"]
+        params = ckpt.restore_params(args.checkpoint_dir, params_like=abstract)
     else:
         log0("no checkpoint given: generating from RANDOM weights (demo)")
         params = model.init(

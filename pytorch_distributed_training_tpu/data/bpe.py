@@ -133,10 +133,12 @@ class ByteLevelBPETokenizer:
         return ids
 
     def decode(self, ids: Iterable[int]) -> str:
-        text = "".join(self.decoder[int(i)] for i in ids)
-        return bytes(self.byte_decoder[c] for c in text).decode(
-            "utf-8", errors="replace"
-        )
+        # unknown ids (e.g. a model vocab larger than the tokenizer's)
+        # become U+FFFD instead of crashing after generation completed
+        text = "".join(self.decoder.get(int(i), "\ufffd") for i in ids)
+        return bytes(
+            self.byte_decoder.get(c, ord("?")) for c in text
+        ).decode("utf-8", errors="replace")
 
 
 class ByteTokenizer:

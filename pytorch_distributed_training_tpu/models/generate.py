@@ -33,6 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_RUN_CACHE: dict = {}
+
+
 def _sample(logits, rng, temperature: float, top_k: int):
     """logits [B, V] -> token ids [B]."""
     if temperature <= 0.0:
@@ -102,6 +105,10 @@ def generate(
         rng = jax.random.key(0)
 
     decode_model = type(model)(dataclasses.replace(cfg, decode=True))
+    run_key = (
+        type(model).__name__, dataclasses.astuple(cfg), batch, prompt_len,
+        max_new_tokens, temperature, top_k, eot_id,
+    )
 
     # Cache buffers are sized by the init input: shape-infer the "cache"
     # collection from an abstract init at total_len (eval_shape — no params
@@ -115,8 +122,8 @@ def generate(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
     )
 
-    @jax.jit
-    def run(params, cache, prompt_ids, prompt_lengths, rng):
+    def make_run():
+      def run(params, cache, prompt_ids, prompt_lengths, rng):
         out = jnp.zeros((batch, total_len), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, prompt_ids, (0, 0))
         positions = jnp.arange(total_len, dtype=jnp.int32)[None, :]
@@ -152,9 +159,7 @@ def generate(
             if eot_id is not None:
                 nxt = jnp.where(done, eot_id, nxt)
                 done = done | (nxt == eot_id)
-            out = jax.lax.dynamic_update_slice(
-                out.T, nxt[None, :], (prompt_len + t, 0)
-            ).T
+            out = out.at[:, prompt_len + t].set(nxt)
             logits, vars_ = decode_model.apply(
                 {"params": params, "cache": cache},
                 nxt[:, None],
@@ -174,4 +179,11 @@ def generate(
         )
         return out
 
+      return jax.jit(run)
+
+    # one compiled program per (model config, shapes, sampling params):
+    # repeated generate() calls reuse the executable instead of retracing
+    run = _RUN_CACHE.get(run_key)
+    if run is None:
+        run = _RUN_CACHE[run_key] = make_run()
     return run(params, cache, prompt_ids, prompt_lengths, rng)

@@ -160,5 +160,35 @@ def state_shardings(state: TrainState, policy: ShardingPolicy, mesh: Mesh):
 
 
 def shard_state(state: TrainState, shardings: TrainState) -> TrainState:
-    """device_put the state onto its shardings (initial placement)."""
-    return jax.tree.map(jax.device_put, state, shardings)
+    """Place the state onto its shardings (initial placement).
+
+    Single-process: plain ``device_put``. Multi-process: ``device_put`` onto
+    a global (non-addressable) sharding is disallowed, so each process
+    materializes only its addressable shards via
+    ``jax.make_array_from_callback`` from the host value — every process
+    holds the same full arrays after the (identically seeded) init, which
+    is exactly the callback contract. PRNG-key leaves are placed through
+    ``key_data``/``wrap_key_data`` (extended dtypes can't ride the raw
+    callback path).
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(jax.device_put, state, shardings)
+
+    import numpy as np
+
+    def _place(x, sh):
+        if jax.dtypes.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key):
+            data = np.asarray(jax.device_get(jax.random.key_data(x)))
+            repl = NamedSharding(sh.mesh, P())  # keys are always replicated
+            placed = jax.make_array_from_callback(
+                data.shape, repl, lambda idx: data[idx]
+            )
+            return jax.random.wrap_key_data(
+                placed, impl=jax.random.key_impl(x)
+            )
+        host = np.asarray(jax.device_get(x))
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx]
+        )
+
+    return jax.tree.map(_place, state, shardings)

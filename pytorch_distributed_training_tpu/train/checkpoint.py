@@ -130,18 +130,34 @@ def latest_step(directory: str) -> Optional[int]:
         return mngr.latest_step()
 
 
-def restore_params(directory: str, *, step: Optional[int] = None):
+def restore_params(directory: str, *, params_like=None, step: Optional[int] = None):
     """Restore ONLY the parameter pytree from a training checkpoint.
 
     The inference-side loader (cli/generate_lm.py): no optimizer state or
-    step counter is reconstructed, and leaves come back as host arrays for
-    the caller to place (single-chip inference just feeds them to apply)."""
+    step counter is reconstructed. With ``params_like`` (a pytree of arrays
+    or ShapeDtypeStructs matching the saved params) the read is a true
+    partial restore — the Adam moments (2x the param bytes) are never
+    touched on disk; without it the full checkpoint is read and the extras
+    dropped. Leaves come back as host arrays for the caller to place."""
     directory = os.path.abspath(directory)
     with ocp.CheckpointManager(directory) as mngr:
         step = mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        restored = mngr.restore(step)
+        if params_like is not None:
+            abstract = {
+                "params": jax.tree.map(
+                    ocp.utils.to_shape_dtype_struct, params_like
+                )
+            }
+            restored = mngr.restore(
+                step,
+                args=ocp.args.PyTreeRestore(
+                    item=abstract, partial_restore=True
+                ),
+            )
+        else:
+            restored = mngr.restore(step)
     log0(f"params restored: {directory}/{step}")
     return dict(restored)["params"]
 

@@ -125,6 +125,11 @@ class ModelConfig:
     # match either way.
     gelu_approximate: bool = True
     remat: bool = False  # jax.checkpoint each layer (trade FLOPs for HBM)
+    # Rematerialize the attention core (scores/softmax/probs) in the
+    # backward pass instead of saving probs residuals — a strict win on the
+    # seq-128 encoder recipe (see models/bert.py); applies to the
+    # "reference" attention impl only.
+    attention_remat: bool = True
     # Stack layers on a leading [num_layers] param dim walked by lax.scan:
     # near-constant compile time in depth, and the layer dim shards over the
     # mesh "stage" axis (ShardingPolicy(stage=True)) — the 2-stage layer

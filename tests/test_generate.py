@@ -142,8 +142,11 @@ def test_generate_cli_smoke(tmp_path):
         tx=tx,
     )
     ckpt.save_checkpoint(str(tmp_path / "ck"), state)
-    restored = ckpt.restore_params(str(tmp_path / "ck"))
-    jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
-        params, restored,
-    )
+    for like in (None, params):  # full read and true partial restore
+        restored = ckpt.restore_params(str(tmp_path / "ck"), params_like=like)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b)
+            ),
+            params, restored,
+        )
