@@ -129,9 +129,31 @@ def load_task_arrays(
             seed=seed if split == "train" else seed + 1,
         )
         return data, num_labels
-    tokenizer = make_tokenizer(vocab_path, vocab_size)
-    arrays = encode_pairs(
-        tokenizer, ds[field_a], ds[field_b], max_length=max_length
-    )
+    arrays = None
+    if vocab_path:
+        # bulk-encode the split in C++ when the toolchain is available (the
+        # HF-fast-tokenizer role; byte-identical to the Python encoder on
+        # ASCII, unicode rows routed to Python — data/native_tokenizer.py)
+        from pytorch_distributed_training_tpu.native import load_wordpiece_lib
+
+        if load_wordpiece_lib() is not None:
+            from pytorch_distributed_training_tpu.data.native_tokenizer import (
+                NativeWordPieceEncoder,
+            )
+
+            enc = NativeWordPieceEncoder(vocab_path)
+            try:
+                arrays = enc.encode_pairs(
+                    list(ds[field_a]), list(ds[field_b]),
+                    max_length=max_length,
+                )
+            finally:
+                enc.close()
+            log0(f"glue/{task} {split}: native C++ WordPiece encode")
+    if arrays is None:
+        tokenizer = make_tokenizer(vocab_path, vocab_size)
+        arrays = encode_pairs(
+            tokenizer, ds[field_a], ds[field_b], max_length=max_length
+        )
     arrays["labels"] = np.asarray(ds["label"], np.int32)
     return arrays, num_labels

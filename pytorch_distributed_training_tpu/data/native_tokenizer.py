@@ -1,0 +1,130 @@
+"""Batch WordPiece encoding through the native C++ encoder.
+
+Drop-in for ``data.tokenizer.encode_pairs`` over a ``WordPieceTokenizer``:
+the whole batch tokenizes in C++ across a thread pool
+(native/src/wordpiece.cpp) with one ctypes call — the role HF's Rust "fast"
+tokenizers play in the reference's stack (reference
+test_data_parallelism.py:69 tokenizes the full dataset up front, which is
+exactly the bulk-encode shape this accelerates).
+
+Parity contract: byte-identical to the Python encoder for ASCII text
+(pinned in tests/test_native_tokenizer.py). Rows containing non-ASCII bytes
+are routed to the Python encoder row-by-row — Python's ``\\w`` is
+unicode-aware and the C++ basic tokenizer is byte-level, so diverging
+silently on unicode would be worse than a slower path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.data.tokenizer import (
+    WordPieceTokenizer,
+    assemble_pair_row,
+)
+from pytorch_distributed_training_tpu.native import load_wordpiece_lib
+
+
+class NativeWordPieceEncoder:
+    """Owns a C++ vocab handle; encodes pair batches to fixed-length arrays."""
+
+    def __init__(self, vocab_path: str, *, lower: bool = False,
+                 n_threads: int | None = None):
+        lib = load_wordpiece_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native wordpiece encoder unavailable (no C++ toolchain?) — "
+                "use data.tokenizer.encode_pairs"
+            )
+        self._lib = lib
+        with open(vocab_path, "rb") as f:
+            blob = f.read()
+        self._h = lib.wp_create(blob, len(blob), int(lower))
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+        self.pad_id = lib.wp_special_id(self._h, 0)
+        self.unk_id = lib.wp_special_id(self._h, 1)
+        self.cls_id = lib.wp_special_id(self._h, 2)
+        self.sep_id = lib.wp_special_id(self._h, 3)
+        # lazy Python twin for non-ASCII rows
+        self._vocab_path = vocab_path
+        self._lower = lower
+        self._py: WordPieceTokenizer | None = None
+
+    def _python_tok(self) -> WordPieceTokenizer:
+        if self._py is None:
+            self._py = WordPieceTokenizer(self._vocab_path, lower=self._lower)
+        return self._py
+
+    @staticmethod
+    def _pack(texts: list[bytes]):
+        off = np.zeros(len(texts) + 1, np.int64)
+        for i, t in enumerate(texts):
+            off[i + 1] = off[i] + len(t)
+        return b"".join(texts), off
+
+    def encode_pairs(self, texts_a, texts_b, max_length: int = 128):
+        """Same output contract as ``data.tokenizer.encode_pairs``."""
+        n = len(texts_a)
+        ids = np.zeros((n, max_length), np.int32)
+        types = np.zeros((n, max_length), np.int32)
+        mask = np.zeros((n, max_length), np.int32)
+        a_bytes = [t.encode("utf-8") for t in texts_a]
+        b_bytes = (
+            [t.encode("utf-8") for t in texts_b]
+            if texts_b is not None
+            else None
+        )
+        non_ascii = [
+            i for i in range(n)
+            if not texts_a[i].isascii()
+            or (texts_b is not None and not texts_b[i].isascii())
+        ]
+        a_blob, a_off = self._pack(a_bytes)
+        if b_bytes is not None:
+            b_blob, b_off = self._pack(b_bytes)
+            b_ptr = b_blob
+            b_off_ptr = b_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        else:
+            b_ptr = None
+            b_off_ptr = None
+        self._lib.wp_encode_pairs(
+            self._h,
+            a_blob, a_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            b_ptr, b_off_ptr,
+            n, max_length, self.n_threads,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        for i in non_ascii:  # unicode rows: Python semantics, overwrite
+            tok = self._python_tok()
+            a_ids = tok.text_ids(texts_a[i])
+            b_ids = tok.text_ids(texts_b[i]) if texts_b is not None else []
+            row_ids, row_types = assemble_pair_row(
+                a_ids, b_ids, max_length, cls_id=tok.cls_id, sep_id=tok.sep_id
+            )
+            ids[i] = 0
+            types[i] = 0
+            mask[i] = 0
+            ids[i, : len(row_ids)] = row_ids
+            types[i, : len(row_ids)] = row_types
+            mask[i, : len(row_ids)] = 1
+        return {
+            "input_ids": ids,
+            "attention_mask": mask,
+            "token_type_ids": types,
+        }
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.wp_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

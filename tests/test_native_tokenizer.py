@@ -1,0 +1,121 @@
+"""Native WordPiece encoder: byte-identical parity with the Python encoder.
+
+The C++ encoder (native/src/wordpiece.cpp) must reproduce
+``data.tokenizer.encode_pairs`` exactly on ASCII text, route unicode rows
+through the Python path, and be thread-count invariant.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.data.tokenizer import (
+    WordPieceTokenizer,
+    encode_pairs,
+)
+from pytorch_distributed_training_tpu.native import load_wordpiece_lib
+
+pytestmark = pytest.mark.skipif(
+    load_wordpiece_lib() is None, reason="no C++ toolchain"
+)
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]",
+    "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+    "over", "lazy", "dog", "un", "##believ", "##able", ",", ".", "!", "'",
+    "a", "b", "c", "1", "2", "##3",
+]
+
+TEXTS_A = [
+    "the quick brown fox jumps",
+    "unbelievable!",
+    "the lazy dog , the fox .",
+    "a b c 123",
+    "zzz unknown words here",
+    "",
+    "the " * 200,  # forces truncation
+]
+TEXTS_B = [
+    "the dog jumped over",
+    "the fox",
+    "unbelievable , a b",
+    "",
+    "the the the",
+    "fox",
+    "dog " * 200,
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+def test_pair_parity_with_python(vocab_file):
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    py = WordPieceTokenizer(vocab_file)
+    want = encode_pairs(py, TEXTS_A, TEXTS_B, max_length=32)
+    nat = NativeWordPieceEncoder(vocab_file)
+    got = nat.encode_pairs(TEXTS_A, TEXTS_B, max_length=32)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    # single-sentence mode too
+    want1 = encode_pairs(py, TEXTS_A, None, max_length=16)
+    got1 = nat.encode_pairs(TEXTS_A, None, max_length=16)
+    for k in want1:
+        np.testing.assert_array_equal(got1[k], want1[k], err_msg=k)
+    nat.close()
+
+
+def test_unicode_rows_fall_back_to_python(vocab_file):
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    a = ["the quick fox", "café naïve", "the dog"]
+    b = ["the dog", "über fox", "lazy"]
+    py = WordPieceTokenizer(vocab_file)
+    want = encode_pairs(py, a, b, max_length=24)
+    nat = NativeWordPieceEncoder(vocab_file)
+    got = nat.encode_pairs(a, b, max_length=24)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    nat.close()
+
+
+def test_thread_count_invariance(vocab_file):
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    rng = np.random.default_rng(0)
+    words = ["the", "quick", "fox", "jumps", "unbelievable", "zzz", "a", "1"]
+    texts = [
+        " ".join(rng.choice(words, rng.integers(1, 40)))
+        for _ in range(257)
+    ]
+    one = NativeWordPieceEncoder(vocab_file, n_threads=1)
+    many = NativeWordPieceEncoder(vocab_file, n_threads=8)
+    x = one.encode_pairs(texts, None, max_length=48)
+    y = many.encode_pairs(texts, None, max_length=48)
+    for k in x:
+        np.testing.assert_array_equal(x[k], y[k], err_msg=k)
+    one.close()
+    many.close()
+
+
+def test_special_ids_match(vocab_file):
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    py = WordPieceTokenizer(vocab_file)
+    nat = NativeWordPieceEncoder(vocab_file)
+    assert (nat.pad_id, nat.unk_id, nat.cls_id, nat.sep_id) == (
+        py.pad_id, py.unk_id, py.cls_id, py.sep_id
+    )
+    nat.close()
