@@ -18,7 +18,10 @@
 //   wp_encode_pairs(handle, a_blob, a_off, b_blob, b_off, n, max_length,
 //                   n_threads, out_ids, out_types, out_mask)
 //       *_blob: concatenated utf-8 rows; *_off: n+1 byte offsets
-//       outputs: [n, max_length] int32, pre-zeroed by the caller
+//       outputs: [n, max_length] int32; C++ writes only each row's used
+//       prefix, so the caller must pre-fill out_ids with pad_id (NOT
+//       necessarily 0) and out_types/out_mask with 0 — padding comes from
+//       that pre-fill
 //   wp_destroy(handle)
 
 #include <atomic>
@@ -48,6 +51,14 @@ inline bool word_char(unsigned char c) {
   return std::isalnum(c) || c == '_';
 }
 
+// Python's re \s on str, restricted to ASCII: C isspace plus the
+// file/group/record/unit separators 0x1c-0x1f (std::isspace misses those,
+// which made "\x1c" tokenize as [UNK] instead of vanishing like the
+// Python twin's \w+|[^\w\s] does).
+inline bool space_char(unsigned char c) {
+  return std::isspace(c) || (c >= 0x1c && c <= 0x1f);
+}
+
 // data/tokenizer.py basic_tokenize: \w+ runs | single non-word non-space
 void basic_tokenize(std::string_view text, bool lower,
                     std::vector<std::string>& out) {
@@ -55,7 +66,7 @@ void basic_tokenize(std::string_view text, bool lower,
   std::string buf;
   while (i < text.size()) {
     unsigned char c = text[i];
-    if (std::isspace(c)) {
+    if (space_char(c)) {
       ++i;
       continue;
     }
@@ -117,25 +128,27 @@ void assemble_row(const Vocab& v, std::vector<int32_t>& a,
                   std::vector<int32_t>& b, int64_t max_length,
                   int32_t* ids, int32_t* types, int32_t* mask) {
   const int64_t specials = 2 + (b.empty() ? 0 : 1);
+  // Caller must guarantee max_length >= specials (the ctypes wrapper
+  // validates per-row); the empty-check and the bounds-checked writes
+  // below keep a bad direct-ABI caller at wrong-output instead of
+  // pop_back-on-empty UB / out-of-row heap writes.
   while ((int64_t)(a.size() + b.size()) > max_length - specials) {
+    if (a.empty() && b.empty()) break;
     if (a.size() >= b.size())
       a.pop_back();
     else
       b.pop_back();
   }
   int64_t p = 0;
-  ids[p] = v.cls_id;
-  types[p] = 0;
-  ++p;
-  for (int32_t t : a) { ids[p] = t; types[p] = 0; ++p; }
-  ids[p] = v.sep_id;
-  types[p] = 0;
-  ++p;
+  auto put = [&](int32_t id, int32_t type) {
+    if (p < max_length) { ids[p] = id; types[p] = type; ++p; }
+  };
+  put(v.cls_id, 0);
+  for (int32_t t : a) put(t, 0);
+  put(v.sep_id, 0);
   if (!b.empty()) {
-    for (int32_t t : b) { ids[p] = t; types[p] = 1; ++p; }
-    ids[p] = v.sep_id;
-    types[p] = 1;
-    ++p;
+    for (int32_t t : b) put(t, 1);
+    put(v.sep_id, 1);
   }
   for (int64_t i = 0; i < p; ++i) mask[i] = 1;
 }

@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover - regex is in the image
 _GPT2_PAT_P = r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
 # re-compatible approximation when `regex` is unavailable: [^\W\d_]
 # approximates \p{L} (unicode letters) and \d approximates \p{N}.
-_GPT2_PAT_RE = r"""'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+"""
+_GPT2_PAT_RE = r"""'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+|\s+(?!\S)|\s+"""
 
 _PRETOK = _re.compile(_GPT2_PAT_P if _HAS_REGEX else _GPT2_PAT_RE)
 

@@ -68,7 +68,24 @@ class NativeWordPieceEncoder:
     def encode_pairs(self, texts_a, texts_b, max_length: int = 128):
         """Same output contract as ``data.tokenizer.encode_pairs``."""
         n = len(texts_a)
-        ids = np.zeros((n, max_length), np.int32)
+        # Per-row specials rule, matching the Python twin: a row needs
+        # [CLS]+[SEP] (2) plus a second [SEP] only if its b tokenizes
+        # non-empty (any non-whitespace char yields >= 1 token via [UNK]
+        # fallback, so a strip() check is exact). The twin raises
+        # IndexError for rows that cannot fit; we raise up front.
+        if max_length < 2 or (
+            texts_b is not None
+            and max_length < 3
+            and any(t.strip() for t in texts_b)
+        ):
+            raise ValueError(
+                f"max_length={max_length} cannot hold a row's "
+                "special tokens"
+            )
+        # C++ writes only the used prefix of each row; padding comes from
+        # this pre-fill, so it must be pad_id (not 0) to match the Python
+        # twin byte-for-byte on vocabs where [PAD] != 0.
+        ids = np.full((n, max_length), self.pad_id, np.int32)
         types = np.zeros((n, max_length), np.int32)
         mask = np.zeros((n, max_length), np.int32)
         a_bytes = [t.encode("utf-8") for t in texts_a]
@@ -106,7 +123,7 @@ class NativeWordPieceEncoder:
             row_ids, row_types = assemble_pair_row(
                 a_ids, b_ids, max_length, cls_id=tok.cls_id, sep_id=tok.sep_id
             )
-            ids[i] = 0
+            ids[i] = self.pad_id
             types[i] = 0
             mask[i] = 0
             ids[i, : len(row_ids)] = row_ids

@@ -26,6 +26,7 @@ positional gap; batch similar-length prompts together when that matters).
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Optional
 
 import jax
@@ -33,7 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
-_RUN_CACHE: dict = {}
+# LRU-bounded: long-running servers cycling many request shapes would
+# otherwise retain one jitted executable (plus closed-over constants) per
+# distinct (config, shapes, sampling params) key forever.
+_RUN_CACHE: "OrderedDict" = OrderedDict()
+_RUN_CACHE_MAX = 32
 
 
 def _sample(logits, rng, temperature: float, top_k: int):
@@ -186,4 +191,8 @@ def generate(
     run = _RUN_CACHE.get(run_key)
     if run is None:
         run = _RUN_CACHE[run_key] = make_run()
+        if len(_RUN_CACHE) > _RUN_CACHE_MAX:
+            _RUN_CACHE.popitem(last=False)
+    else:
+        _RUN_CACHE.move_to_end(run_key)
     return run(params, cache, prompt_ids, prompt_lengths, rng)

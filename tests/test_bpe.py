@@ -107,3 +107,29 @@ def test_encode_lm_rows_shapes(tmp_path):
     np.testing.assert_array_equal(
         out["input_ids"][1], encode_lm_rows(tok, ["a much longer text " * 20], 16)["input_ids"][0]
     )
+
+
+def test_re_fallback_pattern_consumes_every_char():
+    """The `re`-module fallback pre-tokenizer must consume ALL input
+    characters (findall dropping any breaks the lossless decode contract).
+    Regression: '_' matched no alternative (it is \\w but not [^\\W\\d_])."""
+    import re
+
+    from pytorch_distributed_training_tpu.data.bpe import _GPT2_PAT_RE
+
+    pat = re.compile(_GPT2_PAT_RE)
+    for text in SAMPLES + ["a_b", "_leading", "trailing_", "__dunder__ x_1"]:
+        assert "".join(pat.findall(text)) == text
+
+
+def test_re_fallback_roundtrip(tmp_path, monkeypatch):
+    """Force the fallback pattern through the real tokenizer and round-trip."""
+    import re
+
+    import pytorch_distributed_training_tpu.data.bpe as bpe_mod
+
+    vp, mp = _byte_vocab_fixture(tmp_path)
+    tok = ByteLevelBPETokenizer(vp, mp)
+    monkeypatch.setattr(bpe_mod, "_PRETOK", re.compile(bpe_mod._GPT2_PAT_RE))
+    for text in SAMPLES + ["snake_case_name", "_x __y"]:
+        assert tok.decode(tok.text_ids(text)) == text

@@ -119,3 +119,67 @@ def test_special_ids_match(vocab_file):
         py.pad_id, py.unk_id, py.cls_id, py.sep_id
     )
     nat.close()
+
+
+def test_pad_fill_when_pad_id_not_zero(tmp_path):
+    """Padding must use the vocab's [PAD] id, not 0 (regression: the native
+    wrapper pre-filled ids with np.zeros, diverging from the Python twin on
+    any vocab where [PAD] != 0)."""
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    vocab = ["the", "fox", "[PAD]", "[UNK]", "[CLS]", "[SEP]", "dog"]
+    p = tmp_path / "vocab_pad2.txt"
+    p.write_text("\n".join(vocab) + "\n", encoding="utf-8")
+    py = WordPieceTokenizer(str(p))
+    nat = NativeWordPieceEncoder(str(p))
+    assert nat.pad_id == 2
+    # row 0: ASCII (C++ path); row 1: non-ASCII (Python fallback path) —
+    # both must pad with pad_id
+    a, b = ["the fox", "café fox"], ["dog", "dog"]
+    ref = encode_pairs(py, a, b, max_length=16)
+    got = nat.encode_pairs(a, b, max_length=16)
+    for k in ("input_ids", "token_type_ids", "attention_mask"):
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+    for row in range(2):
+        pad_pos = got["attention_mask"][row] == 0
+        assert (got["input_ids"][row][pad_pos] == 2).all()
+
+
+def test_ascii_control_separator_parity(vocab_file):
+    """\\x1c-\\x1f are whitespace to Python's \\s but not to C isspace;
+    the C++ tokenizer must drop them like the Python twin (regression:
+    they tokenized as [UNK])."""
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    py = WordPieceTokenizer(vocab_file)
+    nat = NativeWordPieceEncoder(vocab_file)
+    a = ["the \x1c fox", "dog\x1d\x1e\x1f", "\x1conly"]
+    ref = encode_pairs(py, a, None, max_length=8)
+    got = nat.encode_pairs(a, None, max_length=8)
+    for k in ("input_ids", "token_type_ids", "attention_mask"):
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+
+def test_max_length_too_small_raises(vocab_file):
+    """max_length with no room for the specials must raise, not corrupt
+    memory (regression: C++ assemble_row popped an empty vector — UB)."""
+    from pytorch_distributed_training_tpu.data.native_tokenizer import (
+        NativeWordPieceEncoder,
+    )
+
+    nat = NativeWordPieceEncoder(vocab_file)
+    with pytest.raises(ValueError, match="special tokens"):
+        nat.encode_pairs(["the"], ["fox"], max_length=2)
+    with pytest.raises(ValueError, match="special tokens"):
+        nat.encode_pairs(["the"], None, max_length=1)
+    # per-row rule: an all-empty/whitespace b column needs only 2 specials,
+    # matching the Python twin (which encodes, not raises, here)
+    py = WordPieceTokenizer(vocab_file)
+    ref = encode_pairs(py, ["the"], [" "], max_length=2)
+    got = nat.encode_pairs(["the"], [" "], max_length=2)
+    for k in ("input_ids", "token_type_ids", "attention_mask"):
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
