@@ -31,7 +31,9 @@ q, k and the saved logsumexp (rematerialization instead of HBM round-trips).
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import Optional
 
 import jax
@@ -49,7 +51,6 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _LANES = 128  # minor-dim tile width for fp32 stats outputs
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
-_WARNED_NO_INTERPRET_PROBE = False
 
 
 def _keep_mask(shape, rate: float):
@@ -690,33 +691,41 @@ def flash_attention_base(
     )
 
 
+# Owned signal; no jax private-API probing. Thread-local to mirror jax's
+# interpret-mode config scoping (a global would let one thread's context
+# flip another thread's dispatch).
+_INTERPRET = threading.local()
+
+
+@contextlib.contextmanager
+def tpu_interpret_mode():
+    """Run Pallas TPU kernels in interpret mode off-TPU AND tell the flash
+    dispatch guard the kernel path is live.
+
+    This is the framework-owned replacement for probing jax's private
+    interpret-mode config: tests (and any CPU-host user who wants the
+    kernel semantics) enter this context instead of
+    ``pltpu.force_tpu_interpret_mode()`` directly, so ``_flash_backend_ok``
+    needs no ``jax._src`` imports.
+    """
+    with pltpu.force_tpu_interpret_mode():
+        _INTERPRET.depth = getattr(_INTERPRET, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            _INTERPRET.depth -= 1
+
+
 def _flash_backend_ok() -> bool:
     """Mosaic lowers on TPU only; elsewhere the kernel runs solely under
-    ``pltpu.force_tpu_interpret_mode`` (tests). Off-TPU without that context,
-    dispatch falls back to the reference implementation instead of failing
-    to lower — e.g. the gpt2 presets (attention_impl="flash") on a CPU-only
-    host."""
-    if jax.default_backend() == "tpu":
-        return True
-    try:  # private but the only observable for the interpret context
-        from jax._src import config as _jcfg
-
-        return (
-            _jcfg.pallas_tpu_interpret_mode_context_manager.value is not None
-        )
-    except Exception:
-        global _WARNED_NO_INTERPRET_PROBE
-        if not _WARNED_NO_INTERPRET_PROBE:
-            _WARNED_NO_INTERPRET_PROBE = True
-            import warnings
-
-            warnings.warn(
-                "jax private interpret-mode probe unavailable (jax upgrade?) "
-                "— flash attention disabled off-TPU; update "
-                "_flash_backend_ok (tests/test_flash_attention.py asserts "
-                "this probe works, so a green suite means flash is live)"
-            )
-        return False
+    ``tpu_interpret_mode`` (tests / CPU hosts opting in). Off-TPU without
+    that context, dispatch falls back to the reference implementation
+    instead of failing to lower — e.g. the gpt2 presets
+    (attention_impl="flash") on a CPU-only host."""
+    return (
+        jax.default_backend() == "tpu"
+        or getattr(_INTERPRET, "depth", 0) > 0
+    )
 
 
 # ------------------------------------------------------------ registration
