@@ -61,7 +61,21 @@ def main(argv=None) -> str:
         log0("no --vocab/--merges: using raw-byte fallback tokenizer")
         tok = ByteTokenizer()
 
-    mcfg = model_preset(args.model, scan_layers=False)
+    # Match the checkpoint's trunk layout: train_lm defaults to the scanned
+    # trunk, and generate() re-lays scanned params out itself — the user
+    # never has to know how the checkpoint was trained. Resolve the step
+    # ONCE so the layout probe and the restore read the same checkpoint
+    # even if a training run is writing new steps concurrently.
+    scanned = False
+    ckpt_step = None
+    if args.checkpoint_dir and not args.hf_checkpoint:
+        from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+
+        ckpt_step = ckpt.latest_step(args.checkpoint_dir)
+        if ckpt_step is None:
+            raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+        scanned = ckpt.saved_params_scanned(args.checkpoint_dir, step=ckpt_step)
+    mcfg = model_preset(args.model, scan_layers=scanned)
     if not mcfg.causal:
         raise SystemExit(f"--model {args.model} is not a causal preset")
     if tok.vocab_size > mcfg.vocab_size:
@@ -82,14 +96,14 @@ def main(argv=None) -> str:
 
         params = load_gpt2_lm(args.hf_checkpoint, mcfg)
     elif args.checkpoint_dir:
-        from pytorch_distributed_training_tpu.train import checkpoint as ckpt
-
         abstract = jax.eval_shape(
             lambda: model.init(
                 jax.random.key(0), np.ones((1, 8), np.int32)
             )
         )["params"]
-        params = ckpt.restore_params(args.checkpoint_dir, params_like=abstract)
+        params = ckpt.restore_params(
+            args.checkpoint_dir, params_like=abstract, step=ckpt_step
+        )
     else:
         log0("no checkpoint given: generating from RANDOM weights (demo)")
         params = model.init(

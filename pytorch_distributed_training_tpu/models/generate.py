@@ -39,8 +39,6 @@ import numpy as np
 # distinct (config, shapes, sampling params) key forever.
 _RUN_CACHE: "OrderedDict" = OrderedDict()
 _RUN_CACHE_MAX = 32
-
-
 def _sample(logits, rng, temperature: float, top_k: int):
     """logits [B, V] -> token ids [B]."""
     if temperature <= 0.0:
@@ -67,9 +65,10 @@ def generate(
     """Generate continuations for a batch of right-padded prompts.
 
     Args:
-        model: a ``GPT2LMModel`` (or config-compatible causal LM) with
-            ``scan_layers=False`` (the scanned trunk's stacked param layout
-            has no cache plumbing).
+        model: a ``GPT2LMModel`` (or config-compatible causal LM). A
+            ``scan_layers=True`` model is accepted: its stacked params are
+            re-laid-out to the per-layer form (models/relayout.py) and
+            decode runs the unscanned trunk.
         params: trained parameter pytree for ``model``.
         prompt_ids: [batch, prompt_len] int32, right-padded.
         max_new_tokens: tokens to append per row.
@@ -88,10 +87,19 @@ def generate(
     if not cfg.causal:
         raise ValueError("generate() needs a causal model")
     if cfg.scan_layers:
-        raise ValueError(
-            "generate() supports scan_layers=False models (the scanned "
-            "trunk's stacked param layout has no cache plumbing yet)"
+        # The decode path runs the unscanned trunk (per-layer KV caches);
+        # a scan-trained checkpoint is the same weights in stacked form —
+        # re-layout and decode with scan_layers=False. The re-layout is
+        # per-call (cheap next to decode); hot serving loops can pre-apply
+        # models/relayout.unstack_scanned_params once and pass an
+        # unscanned model+params instead.
+        from pytorch_distributed_training_tpu.models.relayout import (
+            unstack_scanned_params,
         )
+
+        cfg = dataclasses.replace(cfg, scan_layers=False)
+        model = type(model)(cfg)
+        params = unstack_scanned_params(params)
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)

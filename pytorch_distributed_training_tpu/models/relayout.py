@@ -1,0 +1,115 @@
+"""Parameter re-layout between the scanned and unscanned trunk forms.
+
+``config.scan_layers`` stacks every transformer block's params on a leading
+[num_layers] axis under ``layers_scan/<inner>/...`` (bert.py/gpt2.py nn.scan
+trunks); the unscanned trunk names each block ``layer_i``/``block_i`` with
+the same inner tree minus the leading axis. The two layouts hold identical
+weights, so converting is a pure pytree reshape — this module provides both
+directions, letting a checkpoint trained with the scanned trunk (the
+``train_lm`` default) drive KV-cache generation (models/generate.py), which
+runs the unscanned trunk.
+
+Both transforms walk the whole (possibly nested) param dict — the LM's
+trunk sits at top level, the classifier's under ``bert`` — and convert
+every trunk they find.
+
+The reference repo has no trunk-layout concept at all (eager torch modules,
+reference test_model_parallelism.py:92-163); this is the price/benefit of
+the lax.scan compile-time optimization and is framework-owned machinery.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# scanned inner-module name -> unscanned per-layer name prefix
+_SCAN_INNER_TO_PREFIX = {"block": "block_", "layer": "layer_"}
+
+
+def _is_layer_key(key: str, prefix: str) -> bool:
+    return key.startswith(prefix) and key[len(prefix):].isdigit()
+
+
+def has_scanned_trunk(params) -> bool:
+    """True if ``params`` carries a stacked ``layers_scan`` trunk anywhere."""
+    if not isinstance(params, Mapping):
+        return False
+    if "layers_scan" in params:
+        return True
+    return any(has_scanned_trunk(v) for v in params.values())
+
+
+def _scan_inner(trunk: dict) -> str:
+    if len(trunk) != 1:
+        raise ValueError(
+            f"unrecognized layers_scan contents: {sorted(trunk)} "
+            "(expected exactly one inner module)"
+        )
+    (inner,) = trunk
+    if inner not in _SCAN_INNER_TO_PREFIX:
+        raise ValueError(
+            f"unrecognized scanned trunk inner module {inner!r} "
+            f"(known: {sorted(_SCAN_INNER_TO_PREFIX)})"
+        )
+    return inner
+
+
+def unstack_scanned_params(params) -> dict[str, Any]:
+    """[L]-stacked ``layers_scan`` trunks -> per-layer ``block_i``/``layer_i``.
+
+    Returns a NEW dict (leaves are slices of the originals; nothing is
+    copied beyond what ``a[i]`` materializes under jit/np).
+    """
+    if not isinstance(params, Mapping):
+        return params
+    out: dict[str, Any] = {}
+    for k, v in params.items():
+        if k == "layers_scan":
+            inner = _scan_inner(v)
+            prefix = _SCAN_INNER_TO_PREFIX[inner]
+            stacked = v[inner]
+            dims = {int(np.shape(a)[0]) for a in jax.tree.leaves(stacked)}
+            if len(dims) != 1:
+                raise ValueError(
+                    f"inconsistent leading layer dims in layers_scan: {dims}"
+                )
+            (n,) = dims
+            for i in range(n):
+                out[f"{prefix}{i}"] = jax.tree.map(lambda a, i=i: a[i], stacked)
+        else:
+            out[k] = unstack_scanned_params(v)
+    return out
+
+
+def stack_layer_params(params) -> dict[str, Any]:
+    """Per-layer ``block_i``/``layer_i`` params -> [L]-stacked trunks."""
+    if not isinstance(params, Mapping):
+        return params
+    inner = prefix = None
+    for cand_inner, cand_prefix in _SCAN_INNER_TO_PREFIX.items():
+        if any(_is_layer_key(k, cand_prefix) for k in params):
+            inner, prefix = cand_inner, cand_prefix
+            break
+    out: dict[str, Any] = {}
+    if inner is not None:
+        idxs = sorted(
+            int(k[len(prefix):]) for k in params if _is_layer_key(k, prefix)
+        )
+        if idxs != list(range(len(idxs))):
+            raise ValueError(f"non-contiguous layer indices: {idxs}")
+        out["layers_scan"] = {
+            inner: jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[params[f"{prefix}{i}"] for i in idxs],
+            )
+        }
+    for k, v in params.items():
+        if prefix is not None and _is_layer_key(k, prefix):
+            continue
+        out[k] = stack_layer_params(v)
+    return out

@@ -162,6 +162,43 @@ def restore_params(directory: str, *, params_like=None, step: Optional[int] = No
     return dict(restored)["params"]
 
 
+def saved_params_scanned(directory: str, *, step: Optional[int] = None) -> bool:
+    """True if the checkpoint's params use the stacked ``layers_scan`` trunk.
+
+    Reads only checkpoint METADATA (tree structure), no tensor bytes —
+    lets inference entry points (cli/generate_lm.py) construct a model
+    whose layout matches whatever the training run saved, instead of
+    requiring the user to know how the checkpoint was trained.
+    """
+    from pytorch_distributed_training_tpu.models.relayout import (
+        has_scanned_trunk,
+    )
+
+    directory = os.path.abspath(directory)
+    if step is None:
+        with ocp.CheckpointManager(directory) as mngr:
+            step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+    # Resolve the step path through orbax's own name format (not a
+    # hand-built join) so a future step-naming change on the save side
+    # can't silently diverge from this reader.
+    step_path = ocp.step.find_step_path(
+        directory, ocp.step.standard_name_format(), step=step
+    )
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        meta = ckptr.metadata(step_path / "default")
+    finally:
+        ckptr.close()
+    # StepMetadata.item_metadata.tree is the saved pytree structure with
+    # ArrayMetadata leaves (no tensor reads)
+    tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    if not isinstance(tree, dict) or "params" not in tree:
+        raise ValueError(f"unrecognized checkpoint metadata under {directory}")
+    return has_scanned_trunk(tree["params"])
+
+
 def restore_checkpoint(
     directory: str, state: TrainState, *, step: Optional[int] = None
 ) -> TrainState:

@@ -14,6 +14,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 LAUNCH = [sys.executable, "-m", "pytorch_distributed_training_tpu.cli.launch"]
 TRAIN = [
@@ -30,6 +31,7 @@ def _epoch_record(stdout: str) -> dict:
     return {"train_loss": float(m.group(1)), "accuracy": float(m.group(2))}
 
 
+@pytest.mark.slow
 def test_two_process_train_matches_single_process(tmp_path):
     """2 processes x 2 devices must train the same model as 1 process x 4
     devices: same global batches (host-sharded halves), same psum'd grads,
@@ -54,6 +56,7 @@ def test_two_process_train_matches_single_process(tmp_path):
     assert rec_multi["accuracy"] == rec_single["accuracy"]
 
 
+@pytest.mark.slow
 def test_failure_terminates_siblings():
     """A crashing rank must take the job down (the reference's
     ``join=True`` only propagates the crash; siblings blocked in a

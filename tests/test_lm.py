@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
 from pytorch_distributed_training_tpu.utils.config import (
@@ -82,6 +83,7 @@ def test_lm_loss_matches_manual():
     assert float(counts["token_count"]) == 4 * 15
 
 
+@pytest.mark.slow
 def test_lm_trainer_learns_markov_chain(eight_devices):
     """End-to-end: GPT-2-tiny + FSDP mesh on the synthetic Markov corpus.
     The chain has ≈4 plausible next tokens per context (entropy ≈ ln4 with

@@ -170,3 +170,33 @@ def test_gpt2_parity_with_torch_hf(scan_layers):
     model = GPT2LMModel(cfg)
     got = model.apply({"params": params}, jnp.asarray(ids, jnp.int32))
     np.testing.assert_allclose(np.asarray(got), expected, atol=3e-4, rtol=3e-4)
+
+
+def test_bert_scan_relayout_matches_forward():
+    """Scanned-trunk BERT params, unstacked to layer_i form, must drive the
+    unscanned model to identical logits (the encoder twin of the LM
+    generation bridge in models/relayout.py)."""
+    import dataclasses
+
+    from pytorch_distributed_training_tpu.models.relayout import (
+        stack_layer_params,
+        unstack_scanned_params,
+    )
+
+    cfg = tiny_cfg(hidden_dropout=0.0, attention_dropout=0.0)
+    scfg = dataclasses.replace(cfg, scan_layers=True)
+    scanned = BertForSequenceClassification(scfg)
+    ids = jnp.ones((2, 8), jnp.int32)
+    sp = scanned.init(jax.random.key(0), ids)["params"]
+
+    unscanned = BertForSequenceClassification(cfg)
+    up = unstack_scanned_params(sp)
+    out_s = scanned.apply({"params": sp}, ids)
+    out_u = unscanned.apply({"params": up}, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_u), rtol=1e-6, atol=1e-6
+    )
+    restacked = stack_layer_params(up)
+    assert jax.tree.all(
+        jax.tree.map(lambda a, b: jnp.array_equal(a, b), sp, restacked)
+    )

@@ -95,6 +95,7 @@ def test_deterministic_and_epoch_varying():
     assert not np.array_equal(a, c)  # epochs reshuffle
 
 
+@pytest.mark.slow
 def test_trainer_runs_with_native_loader():
     """End-to-end: Trainer with native_loader='on' trains and evals."""
     from pytorch_distributed_training_tpu.parallel import ShardingPolicy

@@ -199,6 +199,7 @@ def test_stage_sharded_scan_forward(eight_devices):
 
 
 @pytest.mark.parametrize("mode", ["branch", "stage"])
+@pytest.mark.slow
 def test_mp_trainer_end_to_end(eight_devices, mode):
     """The MP entry point's Trainer learns on the synthetic task — the
     reference's only verification, on both model-parallel modes."""
@@ -209,7 +210,7 @@ def test_mp_trainer_end_to_end(eight_devices, mode):
     tcfg = TrainConfig(
         num_epochs=1, global_batch_size=32, micro_batch_size=16,
         eval_batch_size=32, learning_rate=1e-3, warmup_steps=5,
-        log_every=0, bf16=False, train_size=512, eval_size=64,
+        log_every=0, bf16=False, train_size=256, eval_size=64,
     )
     if mode == "branch":
         model = BranchEnsembleClassifier(cfg, n_branches=2)

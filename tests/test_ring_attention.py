@@ -82,6 +82,7 @@ def test_ring_grad_matches_reference(seq_mesh, causal):
         )
 
 
+@pytest.mark.slow
 def test_ring_dropout_runs_and_masks(seq_mesh):
     """Dropout path: output differs from deterministic, zero-rate matches."""
     q, k, v = _qkv(seed=3)
@@ -110,6 +111,7 @@ def test_ring_falls_back_without_seq_axis():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_context_parallel_train_step_parity():
     """Full jitted train step on a (data=2, seq=4) mesh with ring attention
     == the same step on a data-only mesh with reference attention: the CP

@@ -22,11 +22,11 @@ def small_trainer(tmp_path=None, **tcfg_kw):
         global_batch_size=32,
         micro_batch_size=16,
         eval_batch_size=32,
-        learning_rate=1e-3,
-        warmup_steps=20,
+        learning_rate=3e-3,
+        warmup_steps=10,
         log_every=0,
         bf16=False,
-        train_size=3072,
+        train_size=1024,
         eval_size=160,
     )
     defaults.update(tcfg_kw)
@@ -40,11 +40,22 @@ def small_trainer(tmp_path=None, **tcfg_kw):
 
 @pytest.fixture(scope="module")
 def trained(eight_devices):
+    """Full 2-epoch learning run — backs the (slow) convergence test."""
     trainer = small_trainer()
     history = trainer.run()
     return trainer, history
 
 
+@pytest.fixture(scope="module")
+def mini_trained(eight_devices):
+    """A cheap trained state for checkpoint plumbing tests (one short
+    epoch; nothing about learning quality is asserted off this)."""
+    trainer = small_trainer(num_epochs=1, train_size=128, eval_size=32)
+    history = trainer.run()
+    return trainer, history
+
+
+@pytest.mark.slow
 def test_trainer_learns_and_reports(trained):
     trainer, history = trained
     assert len(history) == 2
@@ -56,6 +67,7 @@ def test_trainer_learns_and_reports(trained):
     assert history[-1]["samples_per_sec_per_chip"] > 0
 
 
+@pytest.mark.slow
 def test_midepoch_resume_continues_trajectory(eight_devices, tmp_path):
     """A run that checkpoints mid-epoch and resumes must land on the same
     final step count and params as an uninterrupted run (no batch trained
@@ -87,12 +99,12 @@ def test_midepoch_resume_continues_trajectory(eight_devices, tmp_path):
     np.testing.assert_allclose(a, b, atol=1e-5)
 
 
-def test_checkpoint_save_restore_resume(trained, tmp_path):
+def test_checkpoint_save_restore_resume(mini_trained, tmp_path):
     import jax
 
     from pytorch_distributed_training_tpu.train import checkpoint as ckpt
 
-    trainer, _ = trained
+    trainer, _ = mini_trained
     d = str(tmp_path / "ckpt")
     ckpt.save_checkpoint(d, trainer.state)
     step = ckpt.latest_step(d)
@@ -112,7 +124,7 @@ def test_checkpoint_save_restore_resume(trained, tmp_path):
     np.testing.assert_array_equal(a, b)
 
 
-def test_checkpoint_restore_across_prng_impl(trained, tmp_path):
+def test_checkpoint_restore_across_prng_impl(mini_trained, tmp_path):
     """A checkpoint saved under one dropout-PRNG impl restores under another:
     params/opt_state/step carry over, the key falls back to the fresh one
     with a warning instead of a shape-mismatch crash (the key stream itself
@@ -121,7 +133,7 @@ def test_checkpoint_restore_across_prng_impl(trained, tmp_path):
 
     from pytorch_distributed_training_tpu.train import checkpoint as ckpt
 
-    trainer, _ = trained
+    trainer, _ = mini_trained
     d = str(tmp_path / "ckpt_impl")
     ckpt.save_checkpoint(d, trainer.state)
 
