@@ -30,6 +30,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_training_tpu.ops.layer_norm import (
+    FusedDropoutAddLayerNorm,
+    FusedLayerNorm,
+)
 from pytorch_distributed_training_tpu.ops.attention import (
     dot_product_attention,
     make_attention_bias,
@@ -44,6 +48,15 @@ def _dtype(cfg: ModelConfig):
 
 def _pdtype(cfg: ModelConfig):
     return jnp.dtype(cfg.param_dtype)
+
+def _ln(cfg: "ModelConfig", name: str) -> FusedLayerNorm:
+    """LayerNorm with fp32 stats emitting the compute dtype directly (the
+    fused Pallas kernel on TPU; identical jnp math elsewhere)."""
+    return FusedLayerNorm(
+        epsilon=cfg.layer_norm_eps, param_dtype=_pdtype(cfg),
+        out_dtype=_dtype(cfg), impl=cfg.layernorm_impl, name=name,
+    )
+
 
 
 class BertEmbeddings(nn.Module):
@@ -68,9 +81,7 @@ class BertEmbeddings(nn.Module):
                 cfg.type_vocab_size, cfg.hidden_size, embedding_init=embed_init,
                 name="token_type_embeddings", **kw,
             )(token_type_ids)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                         param_dtype=_pdtype(cfg), name="norm")(x)
-        x = x.astype(_dtype(cfg))
+        x = _ln(cfg, "norm")(x)
         return Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
             x, deterministic=deterministic
         )
@@ -84,6 +95,10 @@ class BertSelfAttention(nn.Module):
         cfg = self.config
         kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
                   kernel_init=nn.initializers.normal(stddev=0.02))
+        # Three separate projections, NOT a fused [h, 3h] qkv matmul: the
+        # fused form measured ~2 ms/step SLOWER on v5e (XLA pipelines the
+        # three column matmuls + their consumers better than one wide one
+        # followed by slices; tried 2026-07, see NOTES.md).
         heads_shape = (cfg.num_heads, cfg.head_dim)
         q = nn.DenseGeneral(heads_shape, axis=-1, name="query", **kw)(x)
         k = nn.DenseGeneral(heads_shape, axis=-1, name="key", **kw)(x)
@@ -187,26 +202,27 @@ class BertLayer(nn.Module):
         cfg = self.config
         kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
                   kernel_init=nn.initializers.normal(stddev=0.02))
-        ln = dict(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                  param_dtype=_pdtype(cfg))
+        def tail(name, site):
+            # Dropout -> residual add -> LN as ONE fused op (Pallas kernel
+            # on TPU with the keep-mask regenerated in-kernel; jax.random
+            # dropout + reference LN elsewhere). site splits the PRNG
+            # stream between the block's two tails.
+            return FusedDropoutAddLayerNorm(
+                epsilon=cfg.layer_norm_eps, rate=cfg.hidden_dropout,
+                param_dtype=_pdtype(cfg), out_dtype=_dtype(cfg),
+                impl=cfg.layernorm_impl, site=site,
+                dropout_impl=cfg.dropout_impl, name=name,
+            )
 
         attn_out = BertSelfAttention(cfg, name="attention")(
             x, attention_bias, deterministic
         )
-        attn_out = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
-            attn_out, deterministic=deterministic
-        )
-        x = nn.LayerNorm(**ln, name="attention_norm")(x + attn_out)
-        x = x.astype(_dtype(cfg))
+        x = tail("attention_norm", 0)(attn_out, x, deterministic)
 
         h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(x)
         h = nn.gelu(h, approximate=cfg.gelu_approximate)
         h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
-        h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
-            h, deterministic=deterministic
-        )
-        x = nn.LayerNorm(**ln, name="mlp_norm")(x + h)
-        return x.astype(_dtype(cfg))
+        return tail("mlp_norm", 1)(h, x, deterministic)
 
 
 def default_position_ids(cfg: ModelConfig, input_ids):

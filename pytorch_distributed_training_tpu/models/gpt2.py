@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from pytorch_distributed_training_tpu.models.bert import (
     BertSelfAttention,
     _dtype,
+    _ln,
     _pdtype,
 )
 from pytorch_distributed_training_tpu.ops.attention import make_attention_bias
@@ -41,17 +42,14 @@ class GPT2Block(nn.Module):
         cfg = self.config
         kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
                   kernel_init=nn.initializers.normal(stddev=0.02))
-        ln = dict(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                  param_dtype=_pdtype(cfg))
-
-        h = nn.LayerNorm(**ln, name="ln_1")(x).astype(_dtype(cfg))
+        h = _ln(cfg, "ln_1")(x)
         h = BertSelfAttention(cfg, name="attention")(
             h, attention_bias, deterministic
         )
         h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(h, deterministic=deterministic)
         x = x + h
 
-        h = nn.LayerNorm(**ln, name="ln_2")(x).astype(_dtype(cfg))
+        h = _ln(cfg, "ln_2")(x)
         h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(h)
         h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
         h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
@@ -159,16 +157,13 @@ class GPT2LMModel(nn.Module):
                     x, bias, deterministic
                 )
 
-        x = nn.LayerNorm(
-            epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-            param_dtype=_pdtype(cfg), name="ln_f",
-        )(x)
+        x = _ln(cfg, "ln_f")(x)
         # Tied LM head: logits share the input embedding matrix (GPT-2
         # convention). bf16 operands with fp32 MXU accumulation — the same
         # policy as every other matmul; a full-fp32 vocab matmul runs at
         # half MXU rate and the [B,S,V] logits dominate the LM step.
         logits = jax.lax.dot_general(
-            x.astype(_dtype(cfg)),
+            x,
             wte.embedding.astype(_dtype(cfg)),
             (((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,

@@ -32,7 +32,95 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-DROPOUT_IMPLS = ("exact", "bits32", "bits8")
+DROPOUT_IMPLS = ("exact", "bits32", "bits8", "kernel")
+
+
+def mask_threshold(rate: float) -> "jnp.uint32":
+    """Drop threshold for raw-PRNG-word masks: P(bits >= t) == 1 - rate.
+    Single source of truth for every bits32-style generator (jax-stream
+    and in-kernel alike) so the keep probability can't drift between
+    implementations."""
+    return jnp.uint32(min(round(rate * (1 << 32)), (1 << 32) - 1))
+
+
+def derive_kernel_seed(rng):
+    """One int32 scalar tying an in-kernel PRNG stream to a jax key."""
+    return jax.lax.bitcast_convert_type(
+        jax.random.bits(rng, (1,), jnp.uint32), jnp.int32
+    )
+
+
+def pow2_row_block(rows: int, block_r: int, floor: int = 16) -> int:
+    """Largest power-of-2 row block <= block_r dividing rows (>= floor
+    required by Mosaic's sublane tiling; returns a value < floor when no
+    admissible block exists — callers fall back)."""
+    br = block_r
+    while br >= floor and rows % br != 0:
+        br //= 2
+    return br
+
+
+def mask_scale_jax(rng, shape, rate: float, dtype):
+    """jax-stream mask-scale tensor (0 or 1/(1-rate)) — the bits32 mask."""
+    bits = jax.random.bits(rng, shape, jnp.uint32)
+    scale = jnp.asarray(1.0 / (1.0 - rate), dtype)
+    return jnp.where(bits >= mask_threshold(rate), scale, jnp.zeros((), dtype))
+
+
+def _mask_scale_kernel(seed_ref, o_ref, *, rate: float):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.bitcast(
+        pltpu.prng_random_bits(o_ref.shape), jnp.uint32
+    )
+    thresh = mask_threshold(rate)
+    # select in fp32 (same 32-bit tiling as the predicate — a bf16 select
+    # here trips a Mosaic i1 relayout), convert once at the store
+    scale = jnp.float32(1.0 / (1.0 - rate))
+    o_ref[...] = jnp.where(bits >= thresh, scale, 0.0).astype(o_ref.dtype)
+
+
+def mask_scale_pallas(rng, shape, rate: float, dtype, *, block_r: int = 512):
+    """[shape] tensor of 0 / 1/(1-rate) from the per-core TPU PRNG.
+
+    The x-dtype mask-scale tensor is the ONLY thing that touches HBM —
+    the 4-byte random words live and die in VMEM (the XLA path writes the
+    u32 words, layout-copies them for the transposed consumer, then reads
+    them back: ~3x the bytes on the bert-large probs dropout). The stream
+    is seeded from the jax PRNG key, so it is deterministic per key (and
+    per row-block) but is NOT the jax.random.bits stream; under
+    ``jax.checkpoint`` the regeneration in the backward pass is
+    bit-identical because the seed input is identical.
+    """
+    from jax.experimental import pallas as pl
+
+    n = 1
+    for d in shape:
+        n *= d
+    lanes = 128
+    rows = n // lanes
+    br = pow2_row_block(rows, block_r)
+    if rows * lanes != n or br < 16:
+        # ragged shape: fall back to the jax.random stream
+        return mask_scale_jax(rng, shape, rate, dtype)
+    import functools
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    seed = derive_kernel_seed(rng)
+    out = pl.pallas_call(
+        functools.partial(_mask_scale_kernel, rate=rate),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(rows // br,),
+            in_specs=[],
+            out_specs=pl.BlockSpec((br, lanes), lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), dtype),
+    )(seed)
+    return out.reshape(shape)
 
 
 def raw_dropout(x, rate: float, rng, impl: str = "exact"):
@@ -45,9 +133,6 @@ def raw_dropout(x, rate: float, rng, impl: str = "exact"):
         keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
         return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
     if impl == "bits32":
-        thresh = jnp.uint32(min(round(rate * (1 << 32)), (1 << 32) - 1))
-        bits = jax.random.bits(rng, x.shape, jnp.uint32)
-        scale = jnp.asarray(1.0 / (1.0 - rate), x.dtype)
         # multiply-by-mask-scale (not where(bits, x, 0)): the multiply's
         # backward residual is the small x-dtype mask tensor, so XLA saves
         # that instead of the 4-byte random words (measured: the u32
@@ -57,10 +142,16 @@ def raw_dropout(x, rate: float, rng, impl: str = "exact"):
         # masking a NaN in 10% of positions only hides real numeric bugs
         # (--debug-nans is the detection tool), and finite inputs are
         # bit-identical to the select form.
-        mask_scale = jnp.where(
-            bits >= thresh, scale, jnp.zeros((), x.dtype)
+        return x * mask_scale_jax(rng, x.shape, rate, x.dtype)
+    if impl == "kernel":
+        from pytorch_distributed_training_tpu.ops.layer_norm import (
+            _backend_ok,
         )
-        return x * mask_scale
+
+        if _backend_ok():  # single-device TPU or interpret ctx (see there)
+            return x * mask_scale_pallas(rng, x.shape, rate, x.dtype)
+        # off-TPU / sharded mesh: same mask-scale form, jax.random stream
+        return raw_dropout(x, rate, rng, "bits32")
     if impl == "bits8":
         thresh_i = min(max(round(rate * 256), 1), 255)
         actual_rate = thresh_i / 256.0  # scale by the rate actually applied

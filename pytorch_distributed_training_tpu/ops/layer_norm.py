@@ -1,0 +1,513 @@
+"""Pallas (Mosaic) fused LayerNorm for TPU — fwd + custom-VJP bwd.
+
+Why this kernel exists: on the bert-large MRPC recipe the xprof trace
+(scripts/trace_step.py) shows XLA lowering every ``nn.LayerNorm`` to kLoop
+reduce fusions costing ~0.2 ms per execution — ~37 ms of a ~167 ms step
+across the 49 norms/microbatch (fwd ``convert_reduce_fusion`` ~19 ms + bwd
+``multiply_reduce_fusion`` ~18 ms), an order of magnitude above the HBM
+bandwidth bound for the tensors involved. A hand-fused row-block kernel
+reads/writes each activation exactly once and keeps all statistics math in
+VMEM/fp32. (The reference has no kernels of its own — it rides torch's
+fused LN, reference test_data_parallelism.py:112; this is the TPU-native
+equivalent of that fused native op.)
+
+Contract (matches the ``nn.LayerNorm(dtype=fp32)`` + cast usage in
+models/bert.py, models/gpt2.py):
+
+- input x [..., H] bf16/f32; normalization over the last axis with fp32
+  statistics regardless of input dtype; output = (x - mean) * rsqrt(var +
+  eps) * scale + bias cast to ``out_dtype`` (the models always cast the
+  fp32 LN output straight to bf16, so the kernel emits bf16 directly).
+- ``var`` is the biased variance (ddof=0), eps added inside the rsqrt —
+  identical formula to flax/torch LayerNorm.
+- backward recomputes x_hat from the saved input + (mean, rstd) statistics
+  (no [.., H] fp32 residual), returning dx in x.dtype and fp32 dscale/dbias.
+
+Dispatch: Mosaic lowers on TPU only. Off-TPU (the CPU test mesh) the public
+entry point runs a jnp reference with the exact same math unless the
+caller is inside ``ops.flash_attention.tpu_interpret_mode`` (kernel parity
+tests). Shapes that don't tile (H not a multiple of 128) also fall back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_training_tpu.ops.dropout import (
+    derive_kernel_seed,
+    mask_threshold,
+    pow2_row_block,
+    raw_dropout,
+)
+
+_LANES = 128  # stats outputs are lane-broadcast to the minor-dim tile width
+_DEFAULT_BLOCK_R = 256
+
+
+def reference_layer_norm(x, scale, bias, *, eps: float, out_dtype=None):
+    """jnp twin of the kernel: fp32 stats, biased variance, cast at the end."""
+    out_dtype = out_dtype or x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    c = xf - mean
+    var = jnp.mean(c * c, axis=-1, keepdims=True)
+    y = c * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(out_dtype)
+
+
+# --------------------------------------------------------------------- fwd
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, *, eps: float):
+    xf = x_ref[...].astype(jnp.float32)  # [block_r, H]
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    c = xf - mean
+    var = jnp.mean(c * c, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = c * rstd * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
+        jnp.float32
+    )
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _fwd(x2d, scale, bias, *, eps: float, out_dtype, block_r: int):
+    rows, h = x2d.shape
+    grid = (rows // block_r,)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_r, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_r, h), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, h), out_dtype),
+    )(x2d, scale[None, :], bias[None, :])
+
+
+# --------------------------------------------------------------------- bwd
+
+
+def _bwd_kernel(x_ref, dy_ref, scale_ref,
+                dx_ref, dscale_ref, dbias_ref, *, eps: float):
+    xf = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    # stats recomputed from the (already loaded) input — cheaper than
+    # round-tripping [rows, 128] lane-broadcast fp32 residuals through HBM
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    cx = xf - mean
+    var = jnp.mean(cx * cx, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = cx * rstd
+    wdy = dy * scale_ref[...].astype(jnp.float32)
+    h = xf.shape[-1]
+    c1 = jnp.sum(wdy * xhat, axis=-1, keepdims=True) / h
+    c2 = jnp.sum(wdy, axis=-1, keepdims=True) / h
+    dx = (wdy - xhat * c1 - c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # Per-block partial param grads, summed across blocks by the caller.
+    # Mosaic wants >= 8 sublanes per output block, so the [H] partial is
+    # written sublane-broadcast into an [1, 8, H] block (row 0 is read).
+    dscale_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy * xhat, axis=0)[None, None, :], dscale_ref.shape
+    )
+    dbias_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy, axis=0)[None, None, :], dbias_ref.shape
+    )
+
+
+def _bwd(x2d, dy2d, scale, *, eps: float, block_r: int):
+    rows, h = x2d.shape
+    nblocks = rows // block_r
+    dx, dscale_p, dbias_p = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((block_r, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_r, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, h), x2d.dtype),
+            jax.ShapeDtypeStruct((nblocks, 8, h), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 8, h), jnp.float32),
+        ],
+    )(x2d, dy2d, scale[None, :])
+    return dx, jnp.sum(dscale_p[:, 0], axis=0), jnp.sum(dbias_p[:, 0], axis=0)
+
+
+# ------------------------------------------------------- public entry point
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_layer_norm(x2d, scale, bias, eps, out_dtype, block_r):
+    return _fwd(x2d, scale, bias, eps=eps, out_dtype=out_dtype,
+                block_r=block_r)
+
+
+def _fused_vjp_fwd(x2d, scale, bias, eps, out_dtype, block_r):
+    y = _fwd(x2d, scale, bias, eps=eps, out_dtype=out_dtype, block_r=block_r)
+    return y, (x2d, scale)
+
+
+def _fused_vjp_bwd(eps, out_dtype, block_r, res, dy):
+    x2d, scale = res
+    dx, dscale, dbias = _bwd(
+        x2d, dy.astype(x2d.dtype), scale, eps=eps, block_r=block_r
+    )
+    return dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+
+
+_fused_layer_norm.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+def _backend_ok() -> bool:
+    """Kernel dispatch: real single-device TPU, or the interpret context.
+
+    Multi-device runs fall back to the jnp math on purpose: a pallas
+    custom call under GSPMD is treated as replicated by the SPMD
+    partitioner (all-gather of the sharded activations per call) — correct
+    but catastrophically slow. Sharded meshes get XLA's LN until the
+    kernels are routed through shard_map (future work, NOTES.md)."""
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        _INTERPRET,
+        _flash_backend_ok,
+    )
+
+    if getattr(_INTERPRET, "depth", 0) > 0:
+        return True
+    return _flash_backend_ok() and jax.device_count() == 1
+
+
+def layer_norm(
+    x,
+    scale,
+    bias,
+    *,
+    eps: float = 1e-12,
+    out_dtype=None,
+    block_r: int = _DEFAULT_BLOCK_R,
+    impl: str = "fused",
+):
+    """LayerNorm over the last axis; fp32 stats; output cast to out_dtype.
+
+    ``impl``: "fused" uses the Pallas kernel when the backend supports it
+    and shapes tile (falls back to the jnp reference otherwise);
+    "reference" always uses the jnp math.
+    """
+    if impl not in ("fused", "reference"):
+        raise ValueError(
+            f"unknown layernorm impl {impl!r}; have ('fused', 'reference')"
+        )
+    out_dtype = out_dtype or x.dtype
+    h = x.shape[-1]
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= d
+    # largest power-of-2 row block <= block_r dividing rows; Mosaic's bf16
+    # tile needs >= 16 sublanes, so smaller row counts use the reference
+    br = pow2_row_block(rows, block_r)
+    usable = (
+        impl == "fused"
+        and h % _LANES == 0
+        and br >= 16
+        and _backend_ok()
+    )
+    if not usable:
+        return reference_layer_norm(x, scale, bias, eps=eps,
+                                    out_dtype=out_dtype)
+    x2d = x.reshape(rows, h)
+    y = _fused_layer_norm(x2d, scale, bias, eps, jnp.dtype(out_dtype), br)
+    return y.reshape(*x.shape[:-1], h)
+
+
+# ------------------------------------------------- dropout + add + LN (v2)
+#
+# The post-LN block tail is Dropout(h) -> x + h -> LayerNorm. Materializing
+# the u32 keep-mask words and running the select in whatever fusion XLA
+# picks costs real HBM traffic and throttles neighboring matmul epilogues;
+# this variant regenerates the mask from the per-core PRNG INSIDE the
+# kernel (flash_attention.py's scheme: reseed per (site, block) so fwd and
+# bwd reproduce bit-identical masks) and fuses mask, scale, residual add
+# and the normalization into one read of h/x and one write of y.
+
+
+def _keep_mask(shape, rate: float):
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= mask_threshold(rate)
+
+
+def _dal_fwd_kernel(seed_ref, h_ref, x_ref, scale_ref, bias_ref,
+                    y_ref, s_ref, *, eps: float, rate: float, site: int):
+    i = pl.program_id(0)
+    hf = h_ref[...].astype(jnp.float32)
+    if rate > 0.0:
+        pltpu.prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
+        keep = _keep_mask(hf.shape, rate)
+        hf = jnp.where(keep, hf * (1.0 / (1.0 - rate)), 0.0)
+    s = x_ref[...].astype(jnp.float32) + hf
+    mean = jnp.mean(s, axis=-1, keepdims=True)
+    c = s - mean
+    var = jnp.mean(c * c, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = c * rstd * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
+        jnp.float32
+    )
+    y_ref[...] = y.astype(y_ref.dtype)
+    s_ref[...] = s.astype(s_ref.dtype)
+
+
+def _dal_fwd(h2d, x2d, scale, bias, seed, *, eps, rate, site, out_dtype,
+             block_r):
+    rows, hdim = h2d.shape
+    grid = (rows // block_r,)
+    row_block = lambda i, *_: (i, 0)  # noqa: E731
+    one_block = lambda i, *_: (0, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_dal_fwd_kernel, eps=eps, rate=rate, site=site),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((1, hdim), one_block),
+                pl.BlockSpec((1, hdim), one_block),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((block_r, hdim), row_block),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hdim), out_dtype),
+            jax.ShapeDtypeStruct((rows, hdim), h2d.dtype),
+        ],
+    )(seed, h2d, x2d, scale[None, :], bias[None, :])
+
+
+def _dal_bwd_kernel(seed_ref, s_ref, dy_ref, scale_ref,
+                    dh_ref, dx_ref, dscale_ref, dbias_ref, *,
+                    eps: float, rate: float, site: int):
+    i = pl.program_id(0)
+    sf = s_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    # stats recomputed in VMEM from the saved pre-norm sum (see _bwd_kernel)
+    mean = jnp.mean(sf, axis=-1, keepdims=True)
+    cs = sf - mean
+    var = jnp.mean(cs * cs, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = cs * rstd
+    wdy = dy * scale_ref[...].astype(jnp.float32)
+    hdim = sf.shape[-1]
+    c1 = jnp.sum(wdy * xhat, axis=-1, keepdims=True) / hdim
+    c2 = jnp.sum(wdy, axis=-1, keepdims=True) / hdim
+    ds = (wdy - xhat * c1 - c2) * rstd
+    dx_ref[...] = ds.astype(dx_ref.dtype)
+    if rate > 0.0:
+        pltpu.prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
+        keep = _keep_mask(ds.shape, rate)
+        dh = jnp.where(keep, ds * (1.0 / (1.0 - rate)), 0.0)
+    else:
+        dh = ds
+    dh_ref[...] = dh.astype(dh_ref.dtype)
+    dscale_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy * xhat, axis=0)[None, None, :], dscale_ref.shape
+    )
+    dbias_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy, axis=0)[None, None, :], dbias_ref.shape
+    )
+
+
+def _dal_bwd(s2d, dy2d, scale, seed, *, eps, rate, site, h_dtype,
+             block_r):
+    rows, hdim = s2d.shape
+    nblocks = rows // block_r
+    row_block = lambda i, *_: (i, 0)  # noqa: E731
+    one_block = lambda i, *_: (0, 0)  # noqa: E731
+    dh, dx, dscale_p, dbias_p = pl.pallas_call(
+        functools.partial(_dal_bwd_kernel, eps=eps, rate=rate, site=site),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nblocks,),
+            in_specs=[
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((1, hdim), one_block),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((block_r, hdim), row_block),
+                pl.BlockSpec((1, 8, hdim), lambda i, *_: (i, 0, 0)),
+                pl.BlockSpec((1, 8, hdim), lambda i, *_: (i, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hdim), h_dtype),
+            jax.ShapeDtypeStruct((rows, hdim), h_dtype),
+            jax.ShapeDtypeStruct((nblocks, 8, hdim), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, 8, hdim), jnp.float32),
+        ],
+    )(seed, s2d, dy2d, scale[None, :])
+    return dh, dx, jnp.sum(dscale_p[:, 0], 0), jnp.sum(dbias_p[:, 0], 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _fused_dal(h2d, x2d, scale, bias, seed, eps, rate, site, out_dtype,
+               block_r):
+    y, _ = _dal_fwd(h2d, x2d, scale, bias, seed, eps=eps, rate=rate,
+                    site=site, out_dtype=out_dtype, block_r=block_r)
+    return y
+
+
+def _fused_dal_vjp_fwd(h2d, x2d, scale, bias, seed, eps, rate, site,
+                       out_dtype, block_r):
+    y, s = _dal_fwd(h2d, x2d, scale, bias, seed, eps=eps, rate=rate,
+                    site=site, out_dtype=out_dtype, block_r=block_r)
+    return y, (s, scale, seed)
+
+
+def _fused_dal_vjp_bwd(eps, rate, site, out_dtype, block_r, res, dy):
+    s, scale, seed = res
+    dh, dx, dscale, dbias = _dal_bwd(
+        s, dy.astype(s.dtype), scale, seed, eps=eps, rate=rate,
+        site=site, h_dtype=s.dtype, block_r=block_r,
+    )
+    return dh, dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype), None
+
+
+_fused_dal.defvjp(_fused_dal_vjp_fwd, _fused_dal_vjp_bwd)
+
+
+def dropout_add_layer_norm(
+    h,
+    x,
+    scale,
+    bias,
+    *,
+    rate: float,
+    dropout_rng=None,
+    deterministic: bool = True,
+    eps: float = 1e-12,
+    site: int = 0,
+    out_dtype=None,
+    block_r: int = _DEFAULT_BLOCK_R,
+    impl: str = "fused",
+    dropout_impl: str = "kernel",
+):
+    """LayerNorm(x + Dropout(h)) over the last axis.
+
+    With ``impl="fused"`` AND ``dropout_impl="kernel"`` on TPU, the whole
+    tail runs as one Pallas kernel with the keep-mask regenerated from the
+    per-core PRNG (no mask bytes ever hit HBM; fwd and bwd reseed
+    identically per (site, row-block), so ``site`` must differ between
+    call sites sharing one ``dropout_rng``). Any other ``dropout_impl``
+    keeps that generator's documented mask stream (ops/dropout.py — e.g.
+    "exact" stays bit-identical to flax nn.Dropout) by applying dropout
+    through ``raw_dropout`` and then the LN (still the LN kernel when
+    usable). Off-TPU everything falls back to jax.random + reference LN.
+    """
+    out_dtype = out_dtype or x.dtype
+    hdim = x.shape[-1]
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= d
+    rate = 0.0 if deterministic else rate
+    br = pow2_row_block(rows, block_r)
+    usable = (
+        impl == "fused" and hdim % _LANES == 0 and br >= 16 and _backend_ok()
+    )
+    if not usable or (rate > 0.0 and dropout_impl != "kernel"):
+        if rate > 0.0:
+            h = raw_dropout(h, rate, dropout_rng, dropout_impl)
+        return layer_norm(x + h, scale, bias, eps=eps, out_dtype=out_dtype,
+                          block_r=block_r, impl=impl)
+    if rate > 0.0:
+        # one int32 seed per call; the kernel folds in the block index.
+        seed = derive_kernel_seed(dropout_rng)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    y = _fused_dal(
+        h.reshape(rows, hdim), x.reshape(rows, hdim), scale, bias, seed,
+        eps, float(rate), int(site), jnp.dtype(out_dtype), br,
+    )
+    return y.reshape(x.shape[:-1] + (hdim,))
+
+
+import flax.linen as nn  # noqa: E402
+
+
+class FusedLayerNorm(nn.Module):
+    """flax LayerNorm twin mirroring ``nn.LayerNorm``'s param names/init
+    (``scale`` ones, ``bias`` zeros) so checkpoints and the HF weight
+    mapper are layout-identical whichever impl a config selects. Output is
+    cast to ``out_dtype`` (the models always cast the fp32 LN result to
+    the compute dtype anyway — the kernel just does it in-register)."""
+
+    epsilon: float
+    param_dtype: jnp.dtype
+    out_dtype: jnp.dtype
+    impl: str = "fused"
+
+    @nn.compact
+    def __call__(self, x):
+        h = x.shape[-1]
+        scale = self.param(
+            "scale", nn.initializers.ones, (h,), self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (h,), self.param_dtype
+        )
+        return layer_norm(
+            x, scale, bias, eps=self.epsilon,
+            out_dtype=self.out_dtype, impl=self.impl,
+        )
+
+
+class FusedDropoutAddLayerNorm(nn.Module):
+    """``LayerNorm(x + Dropout(h))`` as one module — the post-LN block
+    tail. Param names match ``nn.LayerNorm`` ("scale"/"bias") so the
+    checkpoint/HF layouts are unchanged vs the unfused Dropout + LN pair.
+
+    ``site`` disambiguates the in-kernel PRNG stream between the two tails
+    of one transformer block (they share the layer's dropout key)."""
+
+    epsilon: float
+    rate: float
+    param_dtype: jnp.dtype
+    out_dtype: jnp.dtype
+    impl: str = "fused"
+    site: int = 0
+    dropout_impl: str = "kernel"
+
+    @nn.compact
+    def __call__(self, h, x, deterministic: bool = True):
+        hdim = x.shape[-1]
+        scale = self.param(
+            "scale", nn.initializers.ones, (hdim,), self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (hdim,), self.param_dtype
+        )
+        rng = None
+        if not deterministic and self.rate > 0.0:
+            rng = self.make_rng("dropout")
+        return dropout_add_layer_norm(
+            h, x, scale, bias, rate=self.rate, dropout_rng=rng,
+            deterministic=deterministic, eps=self.epsilon, site=self.site,
+            out_dtype=self.out_dtype, impl=self.impl,
+            dropout_impl=self.dropout_impl,
+        )

@@ -99,11 +99,13 @@ class ModelConfig:
     # "reference" (XLA einsum) | "flash" (Pallas kernel, ops/flash_attention)
     # | "ring" (sequence-parallel, ops/ring_attention)
     attention_impl: str = "reference"
-    # Dropout mask generator (ops/dropout.py): "bits32" compares raw PRNG
-    # words (no int->float conversion; same 1/2^32 granularity — fp32
-    # uniforms only carry 24 random bits); "exact" is bit-exact with flax
-    # nn.Dropout under the same key.
-    dropout_impl: str = "bits32"
+    # Dropout mask generator (ops/dropout.py): "kernel" draws the keep mask
+    # from the per-core TPU PRNG inside a Pallas op (only the x-dtype
+    # mask-scale tensor touches HBM; falls back to bits32 off-TPU);
+    # "bits32" compares raw jax PRNG words (no int->float conversion; same
+    # 1/2^32 granularity — fp32 uniforms only carry 24 random bits);
+    # "exact" is bit-exact with flax nn.Dropout under the same key.
+    dropout_impl: str = "kernel"
     # dtype policy: params fp32, compute bf16 (TPU-native replacement for the
     # reference's fp16 AMP, test_data_parallelism.py:55; SURVEY.md §2b).
     compute_dtype: str = "bfloat16"
@@ -130,6 +132,12 @@ class ModelConfig:
     # seq-128 encoder recipe (see models/bert.py); applies to the
     # "reference" attention impl only.
     attention_remat: bool = True
+    # LayerNorm implementation (ops/layer_norm.py): "fused" = the Pallas
+    # row-block kernel on TPU (fp32 stats, one HBM read/write per tensor —
+    # XLA's kLoop reduce fusions cost ~37 ms/step of the bert-large recipe,
+    # the kernel ~5 ms); "reference" = jnp math. Identical formula either
+    # way; off-TPU both run the jnp path.
+    layernorm_impl: str = "fused"
     # Stack layers on a leading [num_layers] param dim walked by lax.scan:
     # near-constant compile time in depth, and the layer dim shards over the
     # mesh "stage" axis (ShardingPolicy(stage=True)) — the 2-stage layer
@@ -221,9 +229,12 @@ class TrainConfig:
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
-    # AdamW first-moment (m) storage dtype; "bfloat16" halves optimizer-state
-    # traffic for m (v stays fp32 — it sits under the sqrt and needs range)
+    # AdamW moment storage dtypes; "bfloat16" halves that moment's
+    # optimizer-state traffic in the fused update (math stays fp32 —
+    # train/fused_adamw.py). Both convergence-checked on the MRPC recipe
+    # before becoming bench defaults; fp32 is the conservative default.
     adam_mu_dtype: str = "float32"
+    adam_nu_dtype: str = "float32"
     bf16: bool = True
     # Gradient-accumulation carry dtype: "float32" (default) or "bfloat16"
     # (halves the scan-carry HBM traffic; microbatch gradients round to bf16

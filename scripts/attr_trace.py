@@ -1,0 +1,117 @@
+"""Join an xprof trace with the step HLO: classify every fusion by whether
+its fused computation contains a dot/convolution, and report true
+MXU-fusion vs elementwise-fusion vs other time.
+
+Run scripts/trace_step.py first? No — this script does both: builds the
+step, dumps HLO, traces, and prints the joined ledger.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from trace_step import build_step, bucket  # noqa: E402
+
+
+def main():
+    micro = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    steps = 3
+    step, state, batch = build_step(micro)
+    hlo = step.lower(state, batch).compile().as_text()
+
+    # fusion instruction -> called computation name
+    inst_to_comp = {}
+    for m in re.finditer(
+        r"%([a-zA-Z0-9_.\-]+) = [^\n]*? fusion\([^\n]*?calls=%([a-zA-Z0-9_.\-]+)",
+        hlo,
+    ):
+        inst_to_comp[m.group(1)] = m.group(2)
+    # computations containing a dot/conv
+    comp_bodies = {}
+    for m in re.finditer(
+        r"^(?:ENTRY )?%?([a-zA-Z0-9_.\-]+)[^\n]*\{(.*?)^\}", hlo, re.M | re.S
+    ):
+        comp_bodies[m.group(1)] = m.group(2)
+    def has_dot(comp):
+        body = comp_bodies.get(comp, "")
+        return (" dot(" in body or " convolution(" in body
+                or "= dot" in body or "= convolution" in body)
+
+    state, m = step(state, batch)
+    jax.block_until_ready(state.params)
+    tracedir = "/tmp/xprof_attr"
+    shutil.rmtree(tracedir, ignore_errors=True)
+    with jax.profiler.trace(tracedir):
+        for _ in range(steps):
+            state, m = step(state, batch)
+        float(jax.device_get(m["loss"]))
+    paths = glob.glob(tracedir + "/**/*.trace.json.gz", recursive=True)
+    with gzip.open(paths[0], "rt") as f:
+        events = json.load(f)["traceEvents"]
+    device_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "TPU" in str(e.get("args", {}).get("name", ""))
+    }
+    op_tids = {
+        (e["pid"], e["tid"]) for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and e["pid"] in device_pids
+        and "XLA Ops" in str(e.get("args", {}).get("name", ""))
+    }
+    cats = collections.Counter()
+    tops = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        name = e.get("name", "?")
+        ms = e.get("dur", 0) / 1e3 / steps
+        if "fusion" in name:
+            cat = "fusion(MXU)" if has_dot(inst_to_comp.get(name, "")) else \
+                "fusion(elementwise)"
+        elif name.startswith(("dot", "convolution")):
+            cat = "dot(bare)"
+        else:
+            cat = bucket(name)
+        cats[cat] += ms
+        tops[(cat, re.sub(r"[.\d]+$", "", name))] += ms
+    total = sum(cats.values())
+    print(f"\n== micro {micro}: device {total:.1f} ms/step ==")
+    for c, ms in cats.most_common():
+        print(f"  {c:22s} {ms:8.2f} ms")
+    print("\nper (cat, family):")
+    for (c, f), ms in tops.most_common(20):
+        print(f"  {ms:8.2f} ms  [{c}] {f[:80]}")
+
+    # drill into elementwise fusions: instance -> duration, op_name
+    inst_meta = {}
+    for m in re.finditer(
+        r"%([a-zA-Z0-9_.\-]+) = [^\n]*? fusion\([^\n]*?op_name=\"([^\"]+)\"",
+        hlo,
+    ):
+        inst_meta[m.group(1)] = m.group(2)
+    per_inst = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        name = e.get("name", "?")
+        if "fusion" in name and not has_dot(inst_to_comp.get(name, "")):
+            per_inst[name] += e.get("dur", 0) / 1e3 / steps
+    print("\ntop elementwise-fusion instances:")
+    for name, ms in per_inst.most_common(15):
+        meta = inst_meta.get(name, "?")
+        meta = meta.replace("jit(train_step)/", "")[-95:]
+        print(f"  {ms:8.3f} ms  {name[:28]:28s} {meta}")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,12 +39,22 @@ def batch_for(accum, mesh):
     return make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
 
 
-def run(dropout_impl, accum_dtype, micro=32, mu_dtype="float32"):
+def run(dropout_impl, accum_dtype, micro=32, mu_dtype="float32", ln="fused", dropout_rate=None, attn_rate=None, nu_dtype="float32", attn_impl=None, attn_remat=None):
     mesh = build_mesh()
-    mcfg = model_preset("bert-large-cased", dropout_impl=dropout_impl)
+    kw = dict(dropout_impl=dropout_impl, layernorm_impl=ln)
+    if dropout_rate is not None:
+        kw.update(hidden_dropout=dropout_rate, attention_dropout=dropout_rate)
+    if attn_rate is not None:
+        kw.update(attention_dropout=attn_rate)
+    if attn_impl is not None:
+        kw.update(attention_impl=attn_impl)
+    if attn_remat is not None:
+        kw.update(attention_remat=attn_remat)
+    mcfg = model_preset("bert-large-cased", **kw)
     model = BertForSequenceClassification(mcfg)
     tcfg = TrainConfig(global_batch_size=GLOBAL, micro_batch_size=micro,
-                       adam_mu_dtype=mu_dtype)
+                       adam_mu_dtype=mu_dtype, adam_nu_dtype=nu_dtype,
+                       grad_accum_dtype=accum_dtype)
     tx, _ = adamw_with_schedule(tcfg, total_steps=1000)
     example = {
         "input_ids": jnp.ones((2, SEQ), jnp.int32),
@@ -70,7 +80,7 @@ def run(dropout_impl, accum_dtype, micro=32, mu_dtype="float32"):
         _ = float(jax.device_get(m["loss"]))
         best = min(best, (time.perf_counter() - t0) / ITERS)
     print(
-        f"dropout={dropout_impl:7s} acc={accum_dtype:9s} micro={micro:3d} mu={mu_dtype:9s}"
+        f"rate={dropout_rate} attn={attn_rate} impl={attn_impl} micro={micro:3d} mu={mu_dtype:8s} nu={nu_dtype:8s} ln={ln:9s}"
         f"  {best*1e3:7.2f} ms/step  {GLOBAL/best:6.1f} samples/s",
         flush=True,
     )

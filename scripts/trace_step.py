@@ -1,0 +1,177 @@
+"""Capture an xprof trace of the production bert-large train step and print
+a per-op-category time breakdown (device ops only).
+
+Usage: python scripts/trace_step.py [micro] [steps]
+Writes the raw trace under /tmp/xprof_step and prints the bucketed ledger
+(dot/fusion/copy/rng/... in ms per step) — the data source for NOTES.md's
+perf ledger entries.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.models import BertForSequenceClassification
+from pytorch_distributed_training_tpu.parallel import ShardingPolicy, state_shardings
+from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
+from pytorch_distributed_training_tpu.train.state import create_train_state
+from pytorch_distributed_training_tpu.train.step import make_train_step
+from pytorch_distributed_training_tpu.utils.config import TrainConfig, model_preset
+
+GLOBAL, SEQ = 96, 128
+
+
+def build_step(micro):
+    mesh = build_mesh()
+    mcfg = model_preset("bert-large-cased", dropout_impl="kernel")
+    model = BertForSequenceClassification(mcfg)
+    tcfg = TrainConfig(
+        global_batch_size=GLOBAL, micro_batch_size=micro,
+        grad_accum_dtype="bfloat16", adam_mu_dtype="bfloat16",
+        adam_nu_dtype="bfloat16",
+    )
+    tx, _ = adamw_with_schedule(tcfg, total_steps=1000)
+    example = {
+        "input_ids": jnp.ones((2, SEQ), jnp.int32),
+        "attention_mask": jnp.ones((2, SEQ), jnp.int32),
+        "token_type_ids": jnp.zeros((2, SEQ), jnp.int32),
+    }
+    state = create_train_state(model, tx, jax.random.key(42, impl="rbg"), example)
+    shardings = state_shardings(state, ShardingPolicy(), mesh)
+    state = shard_state(state, shardings)
+    step = make_train_step(
+        grad_accum_steps=tcfg.grad_accum_steps, mesh=mesh,
+        state_shardings=shardings, objective="classification",
+        accum_dtype=tcfg.grad_accum_dtype,
+    )
+    import numpy as np
+    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+
+    rng = np.random.default_rng(0)
+    accum = tcfg.grad_accum_steps
+    b = {
+        "input_ids": rng.integers(0, 28996, (accum, micro, SEQ)).astype(np.int32),
+        "attention_mask": np.ones((accum, micro, SEQ), np.int32),
+        "token_type_ids": np.zeros((accum, micro, SEQ), np.int32),
+        "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
+    }
+    batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
+    return step, state, batch
+
+
+def bucket(name: str) -> str:
+    n = name.lower()
+    if n.startswith("fusion") or ".fusion" in n:
+        return "fusion(loop/other)"
+    for key, b in (
+        ("dot", "dot"), ("conv", "dot"), ("copy", "copy"),
+        ("rng", "rng"), ("all-reduce", "collective"),
+        ("dynamic-update", "dus"), ("transpose", "transpose"),
+        ("reduce", "reduce"), ("scatter", "scatter"), ("iota", "misc"),
+    ):
+        if key in n:
+            return b
+    return "misc"
+
+
+def main():
+    micro = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    step, state, batch = build_step(micro)
+    state, m = step(state, batch)  # compile
+    jax.block_until_ready(state.params)
+
+    tracedir = "/tmp/xprof_step"
+    import shutil
+
+    shutil.rmtree(tracedir, ignore_errors=True)
+    with jax.profiler.trace(tracedir):
+        for _ in range(steps):
+            state, m = step(state, batch)
+        float(jax.device_get(m["loss"]))
+
+    # parse the perfetto trace: device-lane complete events
+    paths = glob.glob(tracedir + "/**/*.trace.json.gz", recursive=True)
+    assert paths, "no trace written"
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    # find device process ids (TPU core lanes)
+    device_pids = {
+        e["pid"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "TPU" in str(e.get("args", {}).get("name", ""))
+    }
+    # leaf XLA ops live on the "XLA Ops" thread lanes; module/step lanes
+    # hold container events that would double-count
+    op_tids = {
+        (e["pid"], e["tid"])
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "thread_name"
+        and e["pid"] in device_pids
+        and "XLA Ops" in str(e.get("args", {}).get("name", ""))
+    }
+    # exclusive time per event: subtract children (events nest on a lane)
+    lanes = collections.defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        lanes[(e["pid"], e.get("tid"))].append(e)
+    per_op = collections.Counter()
+    per_bucket = collections.Counter()
+    for lane in lanes.values():
+        lane.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack = []  # (end_ts, name, child_time_accum index)
+        child_time = []
+        for e in lane:
+            ts, dur = e["ts"], e.get("dur", 0)
+            while stack and ts >= stack[-1][0] - 1e-9:
+                end, name, idx = stack.pop()
+                excl = child_time[idx][0] - child_time[idx][1]
+                per_op[name] += excl / 1e3 / steps
+                per_bucket[bucket(name)] += excl / 1e3 / steps
+                if stack:
+                    child_time[stack[-1][2]][1] += child_time[idx][0]
+            stack.append((ts + dur, e.get("name", "?"), len(child_time)))
+            child_time.append([dur, 0.0])
+        while stack:
+            end, name, idx = stack.pop()
+            excl = child_time[idx][0] - child_time[idx][1]
+            per_op[name] += excl / 1e3 / steps
+            per_bucket[bucket(name)] += excl / 1e3 / steps
+            if stack:
+                child_time[stack[-1][2]][1] += child_time[idx][0]
+    total = sum(per_bucket.values())
+    print(f"\n== micro {micro}: device time {total:.1f} ms/step ==")
+    for b, ms in per_bucket.most_common():
+        print(f"  {b:22s} {ms:8.2f} ms")
+    # group ops by name family (trailing .N stripped) to see where time goes
+    fam = collections.Counter()
+    fam_n = collections.Counter()
+    import re
+
+    for name, ms in per_op.items():
+        f = re.sub(r"[.\d]+$", "", name)
+        fam[f] += ms
+        fam_n[f] += 1
+    print("\nop families (exclusive ms/step, count):")
+    for f, ms in fam.most_common(30):
+        print(f"  {ms:8.2f} ms  x{fam_n[f]:<5d} {f[:100]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -310,3 +310,45 @@ def test_supervisor_restarts_and_resumes(tmp_path):
             max_restarts=1,
             backoff_s=0.01,
         )
+
+
+def test_fused_adamw_matches_optax():
+    """train/fused_adamw.py with fp32 moments must match optax.adamw
+    step-for-step (it is the default optimizer via adamw_with_schedule).
+    Moments are bit-identical; updates agree to ~1 ulp/step (XLA fuses the
+    two bias-correction divisions differently), hence rtol 1e-6."""
+    import optax
+
+    from pytorch_distributed_training_tpu.train.fused_adamw import adamw_fused
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+    }
+    sched = optax.linear_schedule(1e-3, 0.0, 50)
+    kw = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    tx_f = adamw_fused(sched, **kw)
+    tx_o = optax.adamw(sched, **kw)
+    s_f, s_o = tx_f.init(params), tx_o.init(params)
+    p_f, p_o = params, params
+    for i in range(5):
+        g = {
+            "w": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+        }
+        u_f, s_f = tx_f.update(g, s_f, p_f)
+        u_o, s_o = tx_o.update(g, s_o, p_o)
+        p_f = optax.apply_updates(p_f, u_f)
+        p_o = optax.apply_updates(p_o, u_o)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(p_f[k]), np.asarray(p_o[k]), rtol=1e-6, atol=1e-8,
+                err_msg=f"step {i} param {k}",
+            )
+    # bf16 moments change storage only, never the tree structure
+    tx_h = adamw_fused(sched, mu_dtype="bfloat16", nu_dtype="bfloat16", **kw)
+    s_h = tx_h.init(params)
+    assert jax.tree_util.tree_structure(s_h) == jax.tree_util.tree_structure(
+        s_f
+    )
