@@ -42,6 +42,7 @@ def run_bench(
     warmup_steps: int = 5,
     timed_steps: int = 30,
     repeats: int = 3,
+    chain_steps: int = 1,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -115,6 +116,7 @@ def run_bench(
         state_shardings=shardings,
         objective=objective,
         accum_dtype=tcfg.grad_accum_dtype,
+        chain_steps=chain_steps,
     )
 
     # A few distinct batches, cycled, with per-step device placement included
@@ -150,8 +152,45 @@ def run_bench(
             mesh, batches_np[i % len(batches_np)], pspec=TRAIN_BATCH_PSPEC
         )
 
-    for i in range(warmup_steps):
-        state, metrics = train_step(state, place(i))
+    if chain_steps > 1:
+        # Chained driver (train/step.py): ONE dispatch per chain_steps
+        # optimizer steps over pre-placed batches. Measured equal to
+        # per-step dispatch on this image (jax's async dispatch already
+        # pipelines the tunnel latency away) — kept as an option since
+        # higher-latency control planes do benefit.
+        import numpy as _np
+        from jax.sharding import PartitionSpec as P
+
+        if chain_steps > timed_steps:
+            raise SystemExit(
+                f"--chain-steps {chain_steps} must be <= --timed-steps "
+                f"{timed_steps}"
+            )
+        timed_steps = (timed_steps // chain_steps) * chain_steps
+
+        def place_chain(i):
+            stack = {
+                k: _np.stack(
+                    [batches_np[(i + j) % len(batches_np)][k]
+                     for j in range(chain_steps)]
+                )
+                for k in batches_np[0]
+            }
+            return make_global_batch(
+                mesh, stack, pspec=P(None, *TRAIN_BATCH_PSPEC)
+            )
+
+        chains = [place_chain(i) for i in range(4)]
+        feed = lambda i: chains[i % len(chains)]  # noqa: E731
+        calls_per_pass = timed_steps // chain_steps
+        warmup_calls = max(warmup_steps // chain_steps, 1)
+    else:
+        feed = place
+        calls_per_pass = timed_steps
+        warmup_calls = warmup_steps
+
+    for i in range(warmup_calls):
+        state, metrics = train_step(state, feed(i))
     jax.block_until_ready(state.params)
 
     # best-of-N passes: the axon tunnel adds sporadic multi-ms stalls; the
@@ -162,26 +201,29 @@ def run_bench(
     elapsed = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for i in range(timed_steps):
-            state, metrics = train_step(state, place(i))
+        for i in range(calls_per_pass):
+            state, metrics = train_step(state, feed(i))
         float(jax.device_get(metrics["loss"]))
         elapsed = min(elapsed, time.perf_counter() - t0)
 
     sps = global_batch * timed_steps / elapsed
     sps_chip = sps / n_chips
     recipe = "causal-LM" if mcfg.causal else "MRPC-recipe"
+    extra = {
+        "samples_per_sec_total": round(sps, 2),
+        "n_chips": n_chips,
+        "platform": jax.devices()[0].platform,
+        "grad_accum_steps": tcfg.grad_accum_steps,
+        "final_loss": float(jax.device_get(metrics["loss"])),
+    }
+    if chain_steps > 1:
+        extra["chain_steps"] = chain_steps
     return {
         "metric": f"{model_name} {recipe} fine-tune throughput (seq {seq_len}, global batch {global_batch}, bf16)",
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 4),
-        "extra": {
-            "samples_per_sec_total": round(sps, 2),
-            "n_chips": n_chips,
-            "platform": jax.devices()[0].platform,
-            "grad_accum_steps": tcfg.grad_accum_steps,
-            "final_loss": float(jax.device_get(metrics["loss"])),
-        },
+        "extra": extra,
     }
 
 
@@ -193,6 +235,8 @@ def main(argv=None):
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--warmup-steps", type=int, default=5)
     p.add_argument("--timed-steps", type=int, default=30)
+    p.add_argument("--chain-steps", type=int, default=1,
+                   help="optimizer steps fused per dispatch (1 = per-step)")
     args = p.parse_args(argv)
     result = run_bench(
         model_name=args.model,
@@ -201,6 +245,7 @@ def main(argv=None):
         seq_len=args.seq_len,
         warmup_steps=args.warmup_steps,
         timed_steps=args.timed_steps,
+        chain_steps=args.chain_steps,
     )
     print(json.dumps(result))
     return result

@@ -67,19 +67,27 @@ def mask_scale_jax(rng, shape, rate: float, dtype):
     return jnp.where(bits >= mask_threshold(rate), scale, jnp.zeros((), dtype))
 
 
+def kernel_keep_mask(shape, rate: float):
+    """In-kernel Bernoulli(1-rate) keep mask from the ALREADY-SEEDED
+    per-core TPU PRNG (call ``pltpu.prng_seed`` first). Shared by every
+    Pallas dropout site (flash attention, the LN tails, mask_scale) so the
+    threshold semantics cannot drift."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= mask_threshold(rate)
+
+
 def _mask_scale_kernel(seed_ref, o_ref, *, rate: float):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
-    bits = pltpu.bitcast(
-        pltpu.prng_random_bits(o_ref.shape), jnp.uint32
-    )
-    thresh = mask_threshold(rate)
+    keep = kernel_keep_mask(o_ref.shape, rate)
     # select in fp32 (same 32-bit tiling as the predicate — a bf16 select
     # here trips a Mosaic i1 relayout), convert once at the store
     scale = jnp.float32(1.0 / (1.0 - rate))
-    o_ref[...] = jnp.where(bits >= thresh, scale, 0.0).astype(o_ref.dtype)
+    o_ref[...] = jnp.where(keep, scale, 0.0).astype(o_ref.dtype)
 
 
 def mask_scale_pallas(rng, shape, rate: float, dtype, *, block_r: int = 512):

@@ -47,18 +47,19 @@ from pytorch_distributed_training_tpu.ops.attention import (
     register_attention,
 )
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512x512 blocks: measured 45% faster than 128x128 on gpt2-medium @ seq
+# 1024 (30.8 -> 44.7 samples/s on v5e — fewer grid iterations, less
+# per-block overhead, same VMEM headroom; 1024-wide blocks VMEM-OOM).
+# Shorter sequences clamp to seq length in the adapter below.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _LANES = 128  # minor-dim tile width for fp32 stats outputs
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 
 
-def _keep_mask(shape, rate: float):
-    """Bernoulli(1-rate) keep mask from the already-seeded per-core PRNG."""
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    # P(bits >= rate * 2^32) == 1 - rate
-    threshold = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
-    return bits >= threshold
+from pytorch_distributed_training_tpu.ops.dropout import (  # noqa: E402
+    kernel_keep_mask as _keep_mask,
+)
 
 
 def _causal_block_mask(qi, kj, block_q, block_k):

@@ -41,7 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from pytorch_distributed_training_tpu.ops.dropout import (
     derive_kernel_seed,
-    mask_threshold,
+    kernel_keep_mask as _keep_mask,
     pow2_row_block,
     raw_dropout,
 )
@@ -62,16 +62,46 @@ def reference_layer_norm(x, scale, bias, *, eps: float, out_dtype=None):
     return y.astype(out_dtype)
 
 
+# ----------------------------------------------------- shared kernel math
+
+
+def _ln_stats(xf, eps: float):
+    """fp32 (mean, rstd, xhat) over the last axis — THE LayerNorm formula,
+    shared by every kernel here so fwd and the bwd recompute can't drift."""
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    c = xf - mean
+    var = jnp.mean(c * c, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    return mean, rstd, c * rstd
+
+
+def _ln_dx(xhat, dy, scale_f32, rstd):
+    """LayerNorm input gradient from fp32 xhat/dy."""
+    wdy = dy * scale_f32
+    h = xhat.shape[-1]
+    c1 = jnp.sum(wdy * xhat, axis=-1, keepdims=True) / h
+    c2 = jnp.sum(wdy, axis=-1, keepdims=True) / h
+    return (wdy - xhat * c1 - c2) * rstd
+
+
+def _write_param_partials(dscale_ref, dbias_ref, dy, xhat):
+    """Per-block partial dscale/dbias, sublane-broadcast into [1, 8, H]
+    blocks (Mosaic wants >= 8 sublanes; callers read row 0 and sum)."""
+    dscale_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy * xhat, axis=0)[None, None, :], dscale_ref.shape
+    )
+    dbias_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy, axis=0)[None, None, :], dbias_ref.shape
+    )
+
+
 # --------------------------------------------------------------------- fwd
 
 
 def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, *, eps: float):
     xf = x_ref[...].astype(jnp.float32)  # [block_r, H]
-    mean = jnp.mean(xf, axis=-1, keepdims=True)
-    c = xf - mean
-    var = jnp.mean(c * c, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    y = c * rstd * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
+    _, _, xhat = _ln_stats(xf, eps)
+    y = xhat * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
         jnp.float32
     )
     y_ref[...] = y.astype(y_ref.dtype)
@@ -102,26 +132,10 @@ def _bwd_kernel(x_ref, dy_ref, scale_ref,
     dy = dy_ref[...].astype(jnp.float32)
     # stats recomputed from the (already loaded) input — cheaper than
     # round-tripping [rows, 128] lane-broadcast fp32 residuals through HBM
-    mean = jnp.mean(xf, axis=-1, keepdims=True)
-    cx = xf - mean
-    var = jnp.mean(cx * cx, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    xhat = cx * rstd
-    wdy = dy * scale_ref[...].astype(jnp.float32)
-    h = xf.shape[-1]
-    c1 = jnp.sum(wdy * xhat, axis=-1, keepdims=True) / h
-    c2 = jnp.sum(wdy, axis=-1, keepdims=True) / h
-    dx = (wdy - xhat * c1 - c2) * rstd
+    _, rstd, xhat = _ln_stats(xf, eps)
+    dx = _ln_dx(xhat, dy, scale_ref[...].astype(jnp.float32), rstd)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    # Per-block partial param grads, summed across blocks by the caller.
-    # Mosaic wants >= 8 sublanes per output block, so the [H] partial is
-    # written sublane-broadcast into an [1, 8, H] block (row 0 is read).
-    dscale_ref[...] = jnp.broadcast_to(
-        jnp.sum(dy * xhat, axis=0)[None, None, :], dscale_ref.shape
-    )
-    dbias_ref[...] = jnp.broadcast_to(
-        jnp.sum(dy, axis=0)[None, None, :], dbias_ref.shape
-    )
+    _write_param_partials(dscale_ref, dbias_ref, dy, xhat)
 
 
 def _bwd(x2d, dy2d, scale, *, eps: float, block_r: int):
@@ -245,13 +259,8 @@ def layer_norm(
 # and the normalization into one read of h/x and one write of y.
 
 
-def _keep_mask(shape, rate: float):
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    return bits >= mask_threshold(rate)
-
-
 def _dal_fwd_kernel(seed_ref, h_ref, x_ref, scale_ref, bias_ref,
-                    y_ref, s_ref, *, eps: float, rate: float, site: int):
+                    y_ref, *s_out, eps: float, rate: float, site: int):
     i = pl.program_id(0)
     hf = h_ref[...].astype(jnp.float32)
     if rate > 0.0:
@@ -259,24 +268,27 @@ def _dal_fwd_kernel(seed_ref, h_ref, x_ref, scale_ref, bias_ref,
         keep = _keep_mask(hf.shape, rate)
         hf = jnp.where(keep, hf * (1.0 / (1.0 - rate)), 0.0)
     s = x_ref[...].astype(jnp.float32) + hf
-    mean = jnp.mean(s, axis=-1, keepdims=True)
-    c = s - mean
-    var = jnp.mean(c * c, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    y = c * rstd * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
+    _, _, xhat = _ln_stats(s, eps)
+    y = xhat * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(
         jnp.float32
     )
     y_ref[...] = y.astype(y_ref.dtype)
-    s_ref[...] = s.astype(s_ref.dtype)
+    if s_out:  # training: save the pre-norm sum for the backward
+        s_out[0][...] = s.astype(s_out[0].dtype)
 
 
 def _dal_fwd(h2d, x2d, scale, bias, seed, *, eps, rate, site, out_dtype,
-             block_r):
+             block_r, save_s=True):
     rows, hdim = h2d.shape
     grid = (rows // block_r,)
     row_block = lambda i, *_: (i, 0)  # noqa: E731
     one_block = lambda i, *_: (0, 0)  # noqa: E731
-    return pl.pallas_call(
+    out_specs = [pl.BlockSpec((block_r, hdim), row_block)]
+    out_shape = [jax.ShapeDtypeStruct((rows, hdim), out_dtype)]
+    if save_s:  # inference-only forwards skip the residual write entirely
+        out_specs.append(pl.BlockSpec((block_r, hdim), row_block))
+        out_shape.append(jax.ShapeDtypeStruct((rows, hdim), h2d.dtype))
+    out = pl.pallas_call(
         functools.partial(_dal_fwd_kernel, eps=eps, rate=rate, site=site),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -287,16 +299,12 @@ def _dal_fwd(h2d, x2d, scale, bias, seed, *, eps, rate, site, out_dtype,
                 pl.BlockSpec((1, hdim), one_block),
                 pl.BlockSpec((1, hdim), one_block),
             ],
-            out_specs=[
-                pl.BlockSpec((block_r, hdim), row_block),
-                pl.BlockSpec((block_r, hdim), row_block),
-            ],
+            out_specs=out_specs,
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, hdim), out_dtype),
-            jax.ShapeDtypeStruct((rows, hdim), h2d.dtype),
-        ],
+        out_shape=out_shape,
     )(seed, h2d, x2d, scale[None, :], bias[None, :])
+    # pallas_call returns a list matching out_shape; normalize to (y, s)
+    return (out[0], out[1]) if save_s else (out[0], None)
 
 
 def _dal_bwd_kernel(seed_ref, s_ref, dy_ref, scale_ref,
@@ -306,16 +314,8 @@ def _dal_bwd_kernel(seed_ref, s_ref, dy_ref, scale_ref,
     sf = s_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
     # stats recomputed in VMEM from the saved pre-norm sum (see _bwd_kernel)
-    mean = jnp.mean(sf, axis=-1, keepdims=True)
-    cs = sf - mean
-    var = jnp.mean(cs * cs, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
-    xhat = cs * rstd
-    wdy = dy * scale_ref[...].astype(jnp.float32)
-    hdim = sf.shape[-1]
-    c1 = jnp.sum(wdy * xhat, axis=-1, keepdims=True) / hdim
-    c2 = jnp.sum(wdy, axis=-1, keepdims=True) / hdim
-    ds = (wdy - xhat * c1 - c2) * rstd
+    _, rstd, xhat = _ln_stats(sf, eps)
+    ds = _ln_dx(xhat, dy, scale_ref[...].astype(jnp.float32), rstd)
     dx_ref[...] = ds.astype(dx_ref.dtype)
     if rate > 0.0:
         pltpu.prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
@@ -324,12 +324,7 @@ def _dal_bwd_kernel(seed_ref, s_ref, dy_ref, scale_ref,
     else:
         dh = ds
     dh_ref[...] = dh.astype(dh_ref.dtype)
-    dscale_ref[...] = jnp.broadcast_to(
-        jnp.sum(dy * xhat, axis=0)[None, None, :], dscale_ref.shape
-    )
-    dbias_ref[...] = jnp.broadcast_to(
-        jnp.sum(dy, axis=0)[None, None, :], dbias_ref.shape
-    )
+    _write_param_partials(dscale_ref, dbias_ref, dy, xhat)
 
 
 def _dal_bwd(s2d, dy2d, scale, seed, *, eps, rate, site, h_dtype,
@@ -369,7 +364,8 @@ def _dal_bwd(s2d, dy2d, scale, seed, *, eps, rate, site, h_dtype,
 def _fused_dal(h2d, x2d, scale, bias, seed, eps, rate, site, out_dtype,
                block_r):
     y, _ = _dal_fwd(h2d, x2d, scale, bias, seed, eps=eps, rate=rate,
-                    site=site, out_dtype=out_dtype, block_r=block_r)
+                    site=site, out_dtype=out_dtype, block_r=block_r,
+                    save_s=False)
     return y
 
 

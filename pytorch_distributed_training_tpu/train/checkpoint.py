@@ -145,15 +145,24 @@ def restore_params(directory: str, *, params_like=None, step: Optional[int] = No
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
         if params_like is not None:
-            abstract = {
-                "params": jax.tree.map(
-                    ocp.utils.to_shape_dtype_struct, params_like
-                )
-            }
+            def _sds(x):
+                # keep an explicit sharding if the caller attached one —
+                # required when restoring a checkpoint written on a
+                # DIFFERENT topology (orbax can't rebuild the saved mesh)
+                sh = getattr(x, "sharding", None)
+                if isinstance(sh, jax.sharding.Sharding):
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+                return ocp.utils.to_shape_dtype_struct(x)
+
+            abstract = {"params": jax.tree.map(_sds, params_like)}
+            restore_args = ocp.checkpoint_utils.construct_restore_args(
+                abstract
+            )
             restored = mngr.restore(
                 step,
                 args=ocp.args.PyTreeRestore(
-                    item=abstract, partial_restore=True
+                    item=abstract, restore_args=restore_args,
+                    partial_restore=True,
                 ),
             )
         else:

@@ -289,6 +289,29 @@ class Trainer:
                         and step_no % cfg.checkpoint_every_steps == 0
                     ):
                         self.checkpointer.save(self.state)
+                    if (
+                        cfg.crash_at_step
+                        and step_no == cfg.crash_at_step
+                        and jax.process_index() == cfg.crash_rank
+                    ):
+                        # fault injection: die like a preempted/killed host
+                        # (no python cleanup, no checkpoint flush)
+                        import os as _os
+
+                        jax.block_until_ready(self.state.params)
+                        if self.checkpointer:
+                            # join async saves: the injected fault models a
+                            # crash AFTER the last periodic checkpoint
+                            # committed, not a torn write race
+                            self.checkpointer._mngr.wait_until_finished()
+                        # plain print: log0 is process-0-gated and the
+                        # crashing rank is usually not 0
+                        print(
+                            f"injected crash at step {step_no} "
+                            f"(rank {jax.process_index()})",
+                            flush=True,
+                        )
+                        _os._exit(13)
                 jax.block_until_ready(self.state.params)
                 train_time = time.perf_counter() - epoch_t0
                 eval_metrics = self.evaluate()

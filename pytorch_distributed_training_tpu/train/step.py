@@ -116,6 +116,7 @@ def make_train_step(
     state_shardings=None,
     objective: str = "classification",
     accum_dtype: str = "float32",
+    chain_steps: int = 1,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -124,6 +125,14 @@ def make_train_step(
     constrained so the micro-batch dim shards over (data, fsdp) and the
     optimizer update runs under the provided state shardings — XLA inserts
     the per-boundary gradient AllReduce over ICI.
+
+    ``chain_steps > 1`` returns a driver over PRE-PLACED batches with an
+    extra leading [chain_steps] dim: ONE dispatch executes that many
+    optimizer steps back-to-back on device (lax.scan over the per-step
+    body). Host dispatch latency — a few ms per call through remote/tunnel
+    runtimes — amortizes across the chain; metrics come back for the LAST
+    step only (per-step metrics would force device->host syncs, defeating
+    the point). The per-step numerics are identical to chain_steps=1.
     """
 
     forward_loss = _LOSS_FNS[objective]
@@ -183,6 +192,17 @@ def make_train_step(
         }
         return new_state, metrics
 
+    if chain_steps > 1:
+        single_step = train_step
+
+        def train_step(state: TrainState, batches):  # noqa: F811
+            def body(st, b):
+                st, m = single_step(st, b)
+                return st, (m["loss"], m["grad_norm"])
+
+            state, (losses, norms) = jax.lax.scan(body, state, batches)
+            return state, {"loss": losses[-1], "grad_norm": norms[-1]}
+
     donate = (0,)
     if mesh is None:
         return jax.jit(train_step, donate_argnums=donate)
@@ -192,7 +212,10 @@ def make_train_step(
     if mesh.shape.get("seq", 1) > 1:
         batch_sharding = None
     else:
-        batch_sharding = NamedSharding(mesh, TRAIN_BATCH_PSPEC)
+        pspec = TRAIN_BATCH_PSPEC
+        if chain_steps > 1:  # extra leading [chain_steps] dim, unsharded
+            pspec = P(None, *pspec)
+        batch_sharding = NamedSharding(mesh, pspec)
     return jax.jit(
         train_step,
         donate_argnums=donate,

@@ -249,6 +249,13 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     checkpoint_every_steps: int = 0  # 0 = per-epoch only
     resume: bool = False
+    # Fault injection (testing the failure->restart->resume loop, SURVEY.md
+    # §5 "failure detection / fault injection" — absent in the reference,
+    # whose only story is crash propagation): process ``crash_rank``
+    # hard-exits (os._exit, no cleanup/checkpoint flush) right after
+    # completing update number ``crash_at_step``. 0 = disabled.
+    crash_at_step: int = 0
+    crash_rank: int = 0
     profile_dir: str | None = None  # enable jax.profiler traces when set
     debug_nans: bool = False
     # Train-batch assembly engine: "auto" uses the native C++ prefetching

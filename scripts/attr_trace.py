@@ -24,8 +24,11 @@ from trace_step import build_step, bucket  # noqa: E402
 
 def main():
     micro = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    model_name = sys.argv[2] if len(sys.argv) > 2 else "bert-large-cased"
+    seq = int(sys.argv[3]) if len(sys.argv) > 3 else None
+    gb = int(sys.argv[4]) if len(sys.argv) > 4 else None
     steps = 3
-    step, state, batch = build_step(micro)
+    step, state, batch = build_step(micro, model_name, seq, gb)
     hlo = step.lower(state, batch).compile().as_text()
 
     # fusion instruction -> called computation name

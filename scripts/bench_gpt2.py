@@ -1,0 +1,102 @@
+"""gpt2-medium @ seq 1024 step-time sweep: attention x remat x scan x micro.
+
+Same chained-timing discipline as bench_combo.py. Edit the combos at the
+bottom; each run() times the production train step on the real chip.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+from pytorch_distributed_training_tpu.comms.mesh import (
+    TRAIN_BATCH_PSPEC,
+    build_mesh,
+)
+from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+from pytorch_distributed_training_tpu.parallel import (
+    ShardingPolicy,
+    state_shardings,
+)
+from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
+from pytorch_distributed_training_tpu.train.state import create_train_state
+from pytorch_distributed_training_tpu.train.step import make_train_step
+from pytorch_distributed_training_tpu.utils.config import (
+    TrainConfig,
+    model_preset,
+)
+
+GLOBAL, SEQ, ITERS = 32, 1024, 8
+
+
+def run(micro=4, block_q=None, block_k=None, **mkw):
+    if block_q or block_k:
+        import pytorch_distributed_training_tpu.ops.flash_attention as fa
+        fa.DEFAULT_BLOCK_Q = block_q or fa.DEFAULT_BLOCK_Q
+        fa.DEFAULT_BLOCK_K = block_k or fa.DEFAULT_BLOCK_K
+    mesh = build_mesh()
+    mcfg = model_preset("gpt2-medium", **mkw)
+    model = GPT2LMModel(mcfg)
+    tcfg = TrainConfig(
+        global_batch_size=GLOBAL, micro_batch_size=micro,
+        max_seq_length=SEQ, grad_accum_dtype="bfloat16",
+        adam_mu_dtype="bfloat16", adam_nu_dtype="bfloat16",
+    )
+    tx, _ = adamw_with_schedule(tcfg, total_steps=1000)
+    example = {
+        "input_ids": jnp.ones((2, SEQ), jnp.int32),
+        "attention_mask": jnp.ones((2, SEQ), jnp.int32),
+    }
+    state = create_train_state(model, tx, jax.random.key(42, impl="rbg"), example)
+    shardings = state_shardings(state, ShardingPolicy(), mesh)
+    state = shard_state(state, shardings)
+    accum = tcfg.grad_accum_steps
+    step = make_train_step(
+        grad_accum_steps=accum, mesh=mesh, state_shardings=shardings,
+        objective="causal_lm", accum_dtype=tcfg.grad_accum_dtype,
+    )
+    rng = np.random.default_rng(0)
+    b = {
+        "input_ids": rng.integers(0, 50257, (accum, micro, SEQ)).astype(np.int32),
+        "attention_mask": np.ones((accum, micro, SEQ), np.int32),
+    }
+    batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
+    state, m = step(state, batch)
+    jax.block_until_ready(state.params)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            state, m = step(state, batch)
+        _ = float(jax.device_get(m["loss"]))
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    flags = " ".join(f"{k}={v}" for k, v in mkw.items())
+    if block_q or block_k:
+        flags += f" bq={block_q} bk={block_k}"
+    sps = GLOBAL / best
+    toks = sps * SEQ
+    print(
+        f"micro={micro} {flags:55s} {best*1e3:8.1f} ms/step "
+        f"{sps:6.2f} samples/s  {toks/1e3:6.1f}k tok/s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    for kw in (
+        dict(micro=4),
+        dict(micro=4, attention_impl="reference"),
+        dict(micro=4, scan_layers=True),
+        dict(micro=8),
+        dict(micro=2),
+        dict(micro=8, remat=True),
+        dict(micro=16, remat=True),
+    ):
+        run(**kw)

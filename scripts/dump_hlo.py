@@ -2,7 +2,7 @@
 named fusions the trace flags as hot (convert_reduce / multiply_reduce /
 bitcast_add families), so trace time can be attributed to actual HLO.
 
-Usage: python scripts/dump_hlo.py [micro] [family_regex]
+Usage: python scripts/dump_hlo.py [micro]
 Writes full text to /tmp/step_hlo.txt.
 """
 
@@ -19,17 +19,12 @@ from trace_step import build_step  # noqa: E402  (same dir)
 
 def main():
     micro = int(sys.argv[1]) if len(sys.argv) > 1 else 32
-    pat = sys.argv[2] if len(sys.argv) > 2 else r"(convert_reduce_fusion|multiply_reduce_fusion|bitcast_add_fusion|convolution_add_fusion)\.\d+"
     step, state, batch = build_step(micro)
     txt = step.lower(state, batch).compile().as_text()
     with open("/tmp/step_hlo.txt", "w") as f:
         f.write(txt)
     print(f"HLO written: /tmp/step_hlo.txt ({len(txt)} bytes)")
-    # print the computation body for ONE representative of each family
-    seen = set()
-    for m in re.finditer(r"%?([a-z_]+fusion)[.\d]*", txt):
-        pass
-    # find fusion definitions: lines like "%convert_reduce_fusion.293 (...) -> ... {"
+    # print ONE representative instruction of each fusion family
     fams = {}
     for m in re.finditer(
         r"^\s*%?((?:[a-z_]+)fusion)\.(\d+)\s.*?(?=^\s*%|\Z)",

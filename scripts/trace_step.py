@@ -31,27 +31,37 @@ from pytorch_distributed_training_tpu.utils.config import TrainConfig, model_pre
 GLOBAL, SEQ = 96, 128
 
 
-def build_step(micro):
+def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None):
+    global_batch = global_batch or GLOBAL
+    seq = seq or SEQ
     mesh = build_mesh()
-    mcfg = model_preset("bert-large-cased", dropout_impl="kernel")
-    model = BertForSequenceClassification(mcfg)
+    mcfg = model_preset(model_name, dropout_impl="kernel")
+    if mcfg.causal:
+        from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+
+        model = GPT2LMModel(mcfg)
+        objective = "causal_lm"
+    else:
+        model = BertForSequenceClassification(mcfg)
+        objective = "classification"
     tcfg = TrainConfig(
-        global_batch_size=GLOBAL, micro_batch_size=micro,
+        global_batch_size=global_batch, micro_batch_size=micro,
+        max_seq_length=seq,
         grad_accum_dtype="bfloat16", adam_mu_dtype="bfloat16",
         adam_nu_dtype="bfloat16",
     )
     tx, _ = adamw_with_schedule(tcfg, total_steps=1000)
     example = {
-        "input_ids": jnp.ones((2, SEQ), jnp.int32),
-        "attention_mask": jnp.ones((2, SEQ), jnp.int32),
-        "token_type_ids": jnp.zeros((2, SEQ), jnp.int32),
+        "input_ids": jnp.ones((2, seq), jnp.int32),
+        "attention_mask": jnp.ones((2, seq), jnp.int32),
+        "token_type_ids": jnp.zeros((2, seq), jnp.int32),
     }
     state = create_train_state(model, tx, jax.random.key(42, impl="rbg"), example)
     shardings = state_shardings(state, ShardingPolicy(), mesh)
     state = shard_state(state, shardings)
     step = make_train_step(
         grad_accum_steps=tcfg.grad_accum_steps, mesh=mesh,
-        state_shardings=shardings, objective="classification",
+        state_shardings=shardings, objective=objective,
         accum_dtype=tcfg.grad_accum_dtype,
     )
     import numpy as np
@@ -61,9 +71,11 @@ def build_step(micro):
     rng = np.random.default_rng(0)
     accum = tcfg.grad_accum_steps
     b = {
-        "input_ids": rng.integers(0, 28996, (accum, micro, SEQ)).astype(np.int32),
-        "attention_mask": np.ones((accum, micro, SEQ), np.int32),
-        "token_type_ids": np.zeros((accum, micro, SEQ), np.int32),
+        "input_ids": rng.integers(
+            0, mcfg.vocab_size, (accum, micro, seq)
+        ).astype(np.int32),
+        "attention_mask": np.ones((accum, micro, seq), np.int32),
+        "token_type_ids": np.zeros((accum, micro, seq), np.int32),
         "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
     }
     batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)

@@ -352,3 +352,28 @@ def test_fused_adamw_matches_optax():
     assert jax.tree_util.tree_structure(s_h) == jax.tree_util.tree_structure(
         s_f
     )
+
+
+def test_chained_steps_match_per_step():
+    """chain_steps=k (one dispatch, k optimizer steps via lax.scan) must
+    reproduce k per-step dispatches exactly."""
+    rng = np.random.default_rng(7)
+    batches = [make_batch(rng, 2, 8) for _ in range(3)]
+
+    s1 = tiny_state()
+    step = make_train_step(grad_accum_steps=2)
+    for b in batches:
+        s1, m1 = step(s1, jax.tree.map(jnp.asarray, b))
+
+    s2 = tiny_state()
+    chained = make_train_step(grad_accum_steps=2, chain_steps=3)
+    stacked = {
+        k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in batches[0]
+    }
+    s2, m2 = chained(s2, stacked)
+
+    assert int(s1.step) == int(s2.step) == 3
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    a = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s1.params)])
+    b = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s2.params)])
+    np.testing.assert_allclose(a, b, atol=1e-6)
