@@ -180,8 +180,10 @@ def run_bench(
                 mesh, stack, pspec=P(None, *TRAIN_BATCH_PSPEC)
             )
 
-        chains = [place_chain(i) for i in range(4)]
-        feed = lambda i: chains[i % len(chains)]  # noqa: E731
+        # placement stays in-loop, matching the per-step path (a real
+        # input pipeline pays H2D either way, so the --chain-steps
+        # comparison isolates dispatch amortization only)
+        feed = place_chain
         calls_per_pass = timed_steps // chain_steps
         warmup_calls = max(warmup_steps // chain_steps, 1)
     else:

@@ -52,6 +52,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-restarts", type=int, default=0,
                    help="restart-from-checkpoint attempts after a crash "
                         "(needs --checkpoint-dir; sets resume on retries)")
+    p.add_argument("--hf-checkpoint", default=None,
+                   help="HF torch checkpoint (dir or model id with local "
+                        "cache) to start from — the reference's pretrained "
+                        "bert-large-cased init (test_data_parallelism.py:112)")
+    p.add_argument("--history-out", default=None,
+                   help="write the per-epoch metric history (the reference's "
+                        "printed accuracy/F1 trajectory, "
+                        "test_data_parallelism.py:164-166) as JSON here")
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -93,14 +101,21 @@ def main(argv=None) -> list[dict]:
 
         cfg = dataclasses.replace(tcfg, resume=tcfg.resume or i > 0)
         return Trainer(
-            mcfg, cfg, mesh_cfg, policy, task=args.task
+            mcfg, cfg, mesh_cfg, policy, task=args.task,
+            hf_checkpoint=args.hf_checkpoint,
         ).run()
 
     from pytorch_distributed_training_tpu.utils.supervisor import (
         run_with_restarts,
     )
 
-    return run_with_restarts(attempt, max_restarts=args.max_restarts)
+    history = run_with_restarts(attempt, max_restarts=args.max_restarts)
+    if args.history_out and __import__("jax").process_index() == 0:
+        import json
+
+        with open(args.history_out, "w") as f:
+            json.dump(history, f, indent=1)
+    return history
 
 
 if __name__ == "__main__":

@@ -755,8 +755,19 @@ def flash_attention(
     batch, q_len, heads, head_dim = q.shape
     kv_len = k.shape[1]
 
-    block_q = min(DEFAULT_BLOCK_Q, q_len)
-    block_k = min(DEFAULT_BLOCK_K, kv_len)
+    def pick_block(n, cap):
+        # largest multiple of 128 <= cap that divides n (so e.g. seq 768
+        # gets 256-wide blocks instead of silently losing the kernel to
+        # the 768 % 512 != 0 fallback); short sequences use one block.
+        if n <= cap:
+            return n
+        for b in range(cap, 127, -128):
+            if n % b == 0:
+                return b
+        return cap  # no divisor: the divisibility check below falls back
+
+    block_q = pick_block(q_len, DEFAULT_BLOCK_Q)
+    block_k = pick_block(kv_len, DEFAULT_BLOCK_K)
     bias_ok = bias is None or (
         bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1
     )

@@ -90,6 +90,11 @@ class Checkpointer:
         log0(f"checkpoint saving: {self.directory}/{step}")
         return os.path.join(self.directory, str(step))
 
+    def wait(self) -> None:
+        """Join any in-flight async save (fault-injection and tests; a
+        normal run only joins at ``close()``)."""
+        self._mngr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 

@@ -90,6 +90,7 @@ class Trainer:
         train_data, num_labels = load_task_arrays(
             task, "train",
             max_length=train_config.max_seq_length,
+            vocab_path=train_config.vocab_path,
             vocab_size=model_config.vocab_size,
             seed=train_config.seed,
             synthetic_sizes=sizes,
@@ -97,6 +98,7 @@ class Trainer:
         eval_data, _ = load_task_arrays(
             task, "validation",
             max_length=train_config.max_seq_length,
+            vocab_path=train_config.vocab_path,
             vocab_size=model_config.vocab_size,
             seed=train_config.seed,
             synthetic_sizes=sizes,
@@ -303,7 +305,7 @@ class Trainer:
                             # join async saves: the injected fault models a
                             # crash AFTER the last periodic checkpoint
                             # committed, not a torn write race
-                            self.checkpointer._mngr.wait_until_finished()
+                            self.checkpointer.wait()
                         # plain print: log0 is process-0-gated and the
                         # crashing rank is usually not 0
                         print(

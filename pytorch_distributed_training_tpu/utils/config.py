@@ -249,6 +249,11 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     checkpoint_every_steps: int = 0  # 0 = per-epoch only
     resume: bool = False
+    # Path to a WordPiece vocab.txt (e.g. from a local HF bert-*-cased
+    # cache): real GLUE text is then encoded with the REAL vocabulary
+    # (C++ bulk encoder when built, data/glue.py) instead of the offline
+    # HashTokenizer stand-in. None = hash tokenizer / synthetic fallback.
+    vocab_path: str | None = None
     # Fault injection (testing the failure->restart->resume loop, SURVEY.md
     # §5 "failure detection / fault injection" — absent in the reference,
     # whose only story is crash propagation): process ``crash_rank``
