@@ -25,6 +25,8 @@ os.environ.setdefault(
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+# launch-test subprocesses inherit this too — see the config.update below
+os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "none")
 
 # Zero-egress image: don't let HF datasets/hub spend ~20s discovering there
 # is no network before the offline synthetic fallback kicks in.
@@ -60,6 +62,11 @@ jax.config.update(
     "jax_persistent_cache_min_entry_size_bytes",
     int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
 )
+# Keep XLA's own AOT sub-caches OUT of the persistent cache: serializing
+# certain SPMD executables (e.g. the fsdp-sharded scanned-LM train step)
+# SIGABRTs inside XLA:CPU's AOT writer on this image. The jax-level
+# executable cache alone is abort-free and still collapses recompiles.
+jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 
 jax.config.update("jax_platforms", "cpu")
 # Private API, required to un-register the axon backend that sitecustomize
