@@ -117,6 +117,12 @@ def run_bench(
         objective=objective,
         accum_dtype=tcfg.grad_accum_dtype,
         chain_steps=chain_steps,
+        # the per-step grad-norm metric costs one extra read of every
+        # gradient leaf (~0.7 GB -> ~1 ms on bert-large, measured +3.6
+        # samples/s off). The Trainer keeps it (it feeds --log-every
+        # diagnostics); the bench matches the reference's hot loop, which
+        # logs nothing per step (reference test_data_parallelism.py:140-150).
+        log_grad_norm=False,
     )
 
     # A few distinct batches, cycled, with per-step device placement included

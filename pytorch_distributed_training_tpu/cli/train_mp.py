@@ -46,10 +46,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "bert-base-cased ×3, test_model_parallelism.py:230-238)")
     p.add_argument("--task", default="auto",
                    help="mrpc | mnli | synthetic | auto (mrpc w/ fallback)")
-    p.add_argument("--mp-mode", default="branch", choices=["branch", "stage"],
+    p.add_argument("--mp-mode", default="branch",
+                   choices=["branch", "stage", "pipeline"],
                    help="branch = TriBert-style ensemble over the model axis; "
-                        "stage = ConcatBert-style layer split over the stage axis")
+                        "stage = ConcatBert-style layer split over the stage "
+                        "axis (serial GSPMD sharding); pipeline = the same "
+                        "layer split run through the GPipe schedule "
+                        "(microbatches stream through stages concurrently)")
     p.add_argument("--n-branches", type=int, default=3)
+    p.add_argument("--pipeline-microbatches", type=int, default=0,
+                   help="GPipe microbatches per train microbatch (pipeline "
+                        "mode; 0 = auto: deepest of 4x/2x/1x the stage "
+                        "count that divides the micro-batch size with "
+                        "per-microbatch batches divisible over data*fsdp)")
     p.add_argument("--attention", default=None)
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--mesh-data", type=int, default=-1)
@@ -70,13 +79,14 @@ def main(argv=None) -> list[dict]:
     mcfg = model_preset(
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
-        scan_layers=args.mp_mode == "stage",
+        scan_layers=args.mp_mode in ("stage", "pipeline"),
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp,
         stage=args.mesh_stage, model=args.mesh_model, seq=args.mesh_seq,
     )
+    model_factory = None
     if args.mp_mode == "branch":
         if args.mesh_model > 1 and args.n_branches % args.mesh_model:
             raise SystemExit(
@@ -95,8 +105,45 @@ def main(argv=None) -> list[dict]:
             )
         model = None  # Trainer default: BertForSequenceClassification
         policy = ShardingPolicy(stage=True, fsdp=args.fsdp)
+        if args.mp_mode == "pipeline":
+            from pytorch_distributed_training_tpu.parallel.pipeline import (
+                GPipeClassifier,
+            )
+
+            def model_factory(
+                mesh, _cfg=mcfg, _n=args.pipeline_microbatches,
+                _micro=tcfg.micro_batch_size,
+            ):
+                # auto n_micro: deepest stream that still leaves each
+                # pipeline microbatch divisible over the data axes (GPipe
+                # wants n_micro >= stages; more microbatches = smaller
+                # bubble). Explicit --pipeline-microbatches skips the
+                # search but keeps the validation.
+                stages = mesh.shape["stage"]
+                dshard = mesh.shape["data"] * mesh.shape["fsdp"]
+                if _n <= 0:
+                    for cand in (4 * stages, 2 * stages, stages):
+                        if _micro % cand == 0 and (_micro // cand) % dshard == 0:
+                            _n = cand
+                            break
+                    else:
+                        raise SystemExit(
+                            f"no pipeline microbatch count in "
+                            f"{{4,2,1}}x{stages} divides micro-batch "
+                            f"{_micro} with per-microbatch batch divisible "
+                            f"by data*fsdp={dshard}; pick sizes explicitly"
+                        )
+                if _micro % _n or (_micro // _n) % dshard:
+                    raise SystemExit(
+                        f"--pipeline-microbatches {_n}: micro-batch "
+                        f"{_micro} must split into {_n} microbatches whose "
+                        f"size divides data*fsdp={dshard}"
+                    )
+                return GPipeClassifier(_cfg, mesh, _n)
+
     trainer = Trainer(
-        mcfg, tcfg, mesh_cfg, policy, task=args.task, model=model
+        mcfg, tcfg, mesh_cfg, policy, task=args.task, model=model,
+        model_factory=model_factory,
     )
     return trainer.run()
 

@@ -90,6 +90,37 @@ def _mask_scale_kernel(seed_ref, o_ref, *, rate: float):
     o_ref[...] = jnp.where(keep, scale, 0.0).astype(o_ref.dtype)
 
 
+def _mask_scale_from_seed(seed, shape, rate: float, dtype,
+                          *, block_r: int = 512):
+    """Kernel core of ``mask_scale_pallas`` from an explicit [1] int32 seed
+    (shard_map bodies offset the seed per device before calling). Returns
+    None when the shape doesn't tile (caller picks its fallback)."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = 1
+    for d in shape:
+        n *= d
+    lanes = 128
+    rows = n // lanes
+    br = pow2_row_block(rows, block_r)
+    if rows * lanes != n or br < 16:
+        return None
+    out = pl.pallas_call(
+        functools.partial(_mask_scale_kernel, rate=rate),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(rows // br,),
+            in_specs=[],
+            out_specs=pl.BlockSpec((br, lanes), lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), dtype),
+    )(seed)
+    return out.reshape(shape)
+
+
 def mask_scale_pallas(rng, shape, rate: float, dtype, *, block_r: int = 512):
     """[shape] tensor of 0 / 1/(1-rate) from the per-core TPU PRNG.
 
@@ -102,33 +133,69 @@ def mask_scale_pallas(rng, shape, rate: float, dtype, *, block_r: int = 512):
     ``jax.checkpoint`` the regeneration in the backward pass is
     bit-identical because the seed input is identical.
     """
-    from jax.experimental import pallas as pl
-
-    n = 1
-    for d in shape:
-        n *= d
-    lanes = 128
-    rows = n // lanes
-    br = pow2_row_block(rows, block_r)
-    if rows * lanes != n or br < 16:
+    out = _mask_scale_from_seed(
+        derive_kernel_seed(rng), shape, rate, dtype, block_r=block_r
+    )
+    if out is None:
         # ragged shape: fall back to the jax.random stream
         return mask_scale_jax(rng, shape, rate, dtype)
-    import functools
+    return out
 
-    from jax.experimental.pallas import tpu as pltpu
 
+def _mask_scale_sharded(x, rate: float, rng):
+    """shard_map-routed kernel mask-scale (ops/dispatch.py): dim 0 shards
+    over the batch axes; dim 1 over the head axis for 4-D (attention
+    probs [B, N, S, S] under tensor parallelism) or the seq axis for 3-D
+    activations. Returns None when the registered mesh doesn't divide the
+    shape (caller falls back to the jax-stream mask)."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_training_tpu.ops import dispatch
+    from pytorch_distributed_training_tpu.ops.dispatch import shard_map
+
+    ctx = dispatch.kernel_ctx()
+    if ctx is None or x.ndim < 2:
+        return None
+    mesh, batch_axes, seq_axis, head_axis = ctx
+    entries = [tuple(batch_axes)]
+    axes_used = list(batch_axes)
+    f0 = dispatch.axes_size(mesh, batch_axes)
+    if x.shape[0] % f0:
+        return None
+    dim1_axis = head_axis if x.ndim == 4 else seq_axis
+    f1 = mesh.shape.get(dim1_axis, 1) if x.ndim >= 3 else 1
+    if x.ndim >= 3:
+        if x.shape[1] % f1:
+            return None
+        entries.append(dim1_axis if f1 > 1 else None)
+        if f1 > 1:
+            axes_used.append(dim1_axis)
+    entries += [None] * (x.ndim - len(entries))
+    local_shape = list(x.shape)
+    local_shape[0] //= f0
+    if x.ndim >= 3:
+        local_shape[1] //= f1
+    # decide tileability on the LOCAL shard shape, outside the body
+    n = 1
+    for d in local_shape:
+        n *= d
+    if (n // 128) * 128 != n or pow2_row_block(n // 128, 512) < 16:
+        return None
     seed = derive_kernel_seed(rng)
-    out = pl.pallas_call(
-        functools.partial(_mask_scale_kernel, rate=rate),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(rows // br,),
-            in_specs=[],
-            out_specs=pl.BlockSpec((br, lanes), lambda i, *_: (i, 0)),
-        ),
-        out_shape=jax.ShapeDtypeStruct((rows, lanes), dtype),
-    )(seed)
-    return out.reshape(shape)
+    spec = P(*entries)
+
+    def body(xl, seedl):
+        with dispatch.manual_region():
+            seedl = seedl + dispatch.linear_device_index(axes_used, mesh)
+            return xl * _mask_scale_from_seed(
+                seedl, xl.shape, rate, xl.dtype
+            )
+
+    dispatch.KERNEL_DISPATCH_COUNTS["mask_scale"] += 1
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, P()), out_specs=spec,
+        check_rep=False,
+    )(x, seed)
 
 
 def raw_dropout(x, rate: float, rng, impl: str = "exact"):
@@ -152,13 +219,16 @@ def raw_dropout(x, rate: float, rng, impl: str = "exact"):
         # bit-identical to the select form.
         return x * mask_scale_jax(rng, x.shape, rate, x.dtype)
     if impl == "kernel":
-        from pytorch_distributed_training_tpu.ops.layer_norm import (
-            _backend_ok,
-        )
+        from pytorch_distributed_training_tpu.ops import dispatch
 
-        if _backend_ok():  # single-device TPU or interpret ctx (see there)
+        mode = dispatch.mode()
+        if mode == "direct":  # single-device TPU or interpret ctx
             return x * mask_scale_pallas(rng, x.shape, rate, x.dtype)
-        # off-TPU / sharded mesh: same mask-scale form, jax.random stream
+        if mode == "shard_map":
+            out = _mask_scale_sharded(x, rate, rng)
+            if out is not None:
+                return out
+        # off-TPU / non-divisible shapes: same mask-scale form, jax stream
         return raw_dropout(x, rate, rng, "bits32")
     if impl == "bits8":
         thresh_i = min(max(round(rate * 256), 1), 255)

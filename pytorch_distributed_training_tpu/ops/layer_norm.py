@@ -189,21 +189,54 @@ _fused_layer_norm.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
 
 
 def _backend_ok() -> bool:
-    """Kernel dispatch: real single-device TPU, or the interpret context.
+    """Direct (un-shard_mapped) kernel dispatch: single-device TPU or the
+    interpret context. Sharded meshes route through shard_map instead
+    (ops/dispatch.py) — never a bare custom call under GSPMD, which would
+    all-gather the sharded activations per call."""
+    from pytorch_distributed_training_tpu.ops import dispatch
 
-    Multi-device runs fall back to the jnp math on purpose: a pallas
-    custom call under GSPMD is treated as replicated by the SPMD
-    partitioner (all-gather of the sharded activations per call) — correct
-    but catastrophically slow. Sharded meshes get XLA's LN until the
-    kernels are routed through shard_map (future work, NOTES.md)."""
-    from pytorch_distributed_training_tpu.ops.flash_attention import (
-        _INTERPRET,
-        _flash_backend_ok,
-    )
+    return dispatch.mode() == "direct"
 
-    if getattr(_INTERPRET, "depth", 0) > 0:
-        return True
-    return _flash_backend_ok() and jax.device_count() == 1
+
+from pytorch_distributed_training_tpu.ops.dispatch import (
+    shard_map as _shard_map,
+)
+
+
+def _row_shard_plan(x, block_r: int):
+    """shard_map plan for a row-wise kernel on ``x`` [..., H]: PartitionSpec
+    (batch axes on dim 0, the seq axis on dim 1 when present), the axis
+    names used (for seed offsetting), and the LOCAL row-block size — or
+    None when the shape doesn't divide over the registered mesh (caller
+    falls back to the XLA math)."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_training_tpu.ops import dispatch
+
+    ctx = dispatch.kernel_ctx()
+    if ctx is None:
+        return None
+    mesh, batch_axes, seq_axis, _ = ctx
+    f0 = dispatch.axes_size(mesh, batch_axes)
+    entries = [tuple(batch_axes)]
+    axes_used = list(batch_axes)
+    f1 = 1
+    if x.ndim >= 3:
+        f1 = mesh.shape.get(seq_axis, 1)
+        entries.append(seq_axis if f1 > 1 else None)
+        if f1 > 1:
+            axes_used.append(seq_axis)
+    entries += [None] * (x.ndim - len(entries))
+    if x.shape[0] % f0 or (x.ndim >= 3 and x.shape[1] % f1):
+        return None
+    rows_local = 1
+    for d in x.shape[:-1]:
+        rows_local *= d
+    rows_local //= f0 * f1
+    br = pow2_row_block(rows_local, block_r)
+    if br < 16:
+        return None
+    return mesh, P(*entries), axes_used, br
 
 
 def layer_norm(
@@ -226,21 +259,38 @@ def layer_norm(
         raise ValueError(
             f"unknown layernorm impl {impl!r}; have ('fused', 'reference')"
         )
+    from pytorch_distributed_training_tpu.ops import dispatch
+
     out_dtype = out_dtype or x.dtype
     h = x.shape[-1]
     rows = 1
     for d in x.shape[:-1]:
         rows *= d
+    mode = dispatch.mode() if impl == "fused" and h % _LANES == 0 else "off"
+    if mode == "shard_map":
+        plan = _row_shard_plan(x, block_r)
+        if plan is not None:
+            mesh, spec, _, br = plan
+            from jax.sharding import PartitionSpec as P
+
+            def body(xl, sl, bl):
+                with dispatch.manual_region():
+                    y = _fused_layer_norm(
+                        xl.reshape(-1, h), sl, bl, eps,
+                        jnp.dtype(out_dtype), br,
+                    )
+                return y.reshape(xl.shape[:-1] + (h,))
+
+            dispatch.KERNEL_DISPATCH_COUNTS["layer_norm"] += 1
+            return _shard_map(
+                body, mesh=mesh, in_specs=(spec, P(), P()),
+                out_specs=spec, check_rep=False,
+            )(x, scale, bias)
+        mode = "off"
     # largest power-of-2 row block <= block_r dividing rows; Mosaic's bf16
     # tile needs >= 16 sublanes, so smaller row counts use the reference
     br = pow2_row_block(rows, block_r)
-    usable = (
-        impl == "fused"
-        and h % _LANES == 0
-        and br >= 16
-        and _backend_ok()
-    )
-    if not usable:
+    if mode != "direct" or br < 16:
         return reference_layer_norm(x, scale, bias, eps=eps,
                                     out_dtype=out_dtype)
     x2d = x.reshape(rows, h)
@@ -416,17 +466,52 @@ def dropout_add_layer_norm(
     through ``raw_dropout`` and then the LN (still the LN kernel when
     usable). Off-TPU everything falls back to jax.random + reference LN.
     """
+    from pytorch_distributed_training_tpu.ops import dispatch
+
     out_dtype = out_dtype or x.dtype
     hdim = x.shape[-1]
     rows = 1
     for d in x.shape[:-1]:
         rows *= d
     rate = 0.0 if deterministic else rate
-    br = pow2_row_block(rows, block_r)
-    usable = (
-        impl == "fused" and hdim % _LANES == 0 and br >= 16 and _backend_ok()
+    mode = (
+        dispatch.mode() if impl == "fused" and hdim % _LANES == 0 else "off"
     )
-    if not usable or (rate > 0.0 and dropout_impl != "kernel"):
+    if rate > 0.0 and dropout_impl != "kernel":
+        mode = "off"  # foreign mask streams can't regenerate in-kernel
+    if mode == "shard_map":
+        plan = _row_shard_plan(x, block_r)
+        if plan is None:
+            mode = "off"
+        else:
+            mesh, spec, axes_used, br = plan
+            from jax.sharding import PartitionSpec as P
+
+            if rate > 0.0:
+                seed = derive_kernel_seed(dropout_rng)
+            else:
+                seed = jnp.zeros((1,), jnp.int32)
+
+            def body(hl, xl, sl, bl, seedl):
+                with dispatch.manual_region():
+                    # distinct in-kernel PRNG stream per shard
+                    seedl = seedl + dispatch.linear_device_index(
+                        axes_used, mesh
+                    )
+                    y = _fused_dal(
+                        hl.reshape(-1, hdim), xl.reshape(-1, hdim), sl, bl,
+                        seedl, eps, float(rate), int(site),
+                        jnp.dtype(out_dtype), br,
+                    )
+                return y.reshape(xl.shape[:-1] + (hdim,))
+
+            dispatch.KERNEL_DISPATCH_COUNTS["dal"] += 1
+            return _shard_map(
+                body, mesh=mesh, in_specs=(spec, spec, P(), P(), P()),
+                out_specs=spec, check_rep=False,
+            )(h, x, scale, bias, seed)
+    br = pow2_row_block(rows, block_r)
+    if mode != "direct" or br < 16:
         if rate > 0.0:
             h = raw_dropout(h, rate, dropout_rng, dropout_impl)
         return layer_norm(x + h, scale, bias, eps=eps, out_dtype=out_dtype,

@@ -90,6 +90,18 @@ def _local_block(q, k, v, bias, *, scale, q_offset, kv_offset, causal,
 def _ring_shard(q, k, v, bias, *, scale, n_shards, causal, dropout_rng,
                 dropout_rate, dropout_impl, axis_name):
     """Per-shard body under shard_map: local Q stays, K/V/bias ring-hop."""
+    from pytorch_distributed_training_tpu.ops import dispatch
+
+    with dispatch.manual_region():
+        return _ring_shard_body(
+            q, k, v, bias, scale=scale, n_shards=n_shards, causal=causal,
+            dropout_rng=dropout_rng, dropout_rate=dropout_rate,
+            dropout_impl=dropout_impl, axis_name=axis_name,
+        )
+
+
+def _ring_shard_body(q, k, v, bias, *, scale, n_shards, causal, dropout_rng,
+                     dropout_rate, dropout_impl, axis_name):
     my = jax.lax.axis_index(axis_name)
     seq_local = q.shape[1]
     perm = [(i, (i - 1) % n_shards) for i in range(n_shards)]  # blocks move left

@@ -28,10 +28,20 @@ Mechanics (classic GPipe fill/drain, expressed functionally):
   keep-all-microbatch-activations memory profile; wrap ``layer_fn`` in
   ``jax.checkpoint`` for the 1F1B-ish memory trade.
 
-The forward is deterministic (no dropout rng streaming yet — the
-correctness tests and the scheduling win don't depend on it; thread a
-per-(tick, stage) key the same way ``ops/layer_norm`` seeds its kernels
-when pipeline training with dropout becomes a target).
+Dropout rng streaming: ``gpipe_apply`` optionally consumes one PRNG key
+per microbatch (streamed alongside the activations like ``bias``); inside
+the schedule each stage folds in its stage index and each layer its local
+layer index, so every (microbatch, layer) dropout site draws from a
+distinct stream — and because the keys are a pure function of the primal
+inputs, ``jax.grad``/remat regenerate bit-identical masks in the backward.
+``GPipeClassifier`` packages the whole thing as an init/apply-compatible
+stand-in for ``BertForSequenceClassification(scan_layers=True)``: same
+parameter tree (checkpoints and ``ShardingPolicy(stage=True)`` shardings
+carry over unchanged; ``models/relayout.py`` converts to/from the
+unscanned layout), embeddings/pooler/head outside the pipelined trunk —
+the trainable generalization of the reference's ConcatBert split
+(reference test_model_parallelism.py:40-89), which also kept embeddings
+with stage 0 and the pooler/classifier with the last stage.
 """
 
 from __future__ import annotations
@@ -39,14 +49,12 @@ from __future__ import annotations
 import functools
 from typing import Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax moved shard_map out of experimental at different versions
-    from jax.experimental.shard_map import shard_map
-except ImportError:  # pragma: no cover
-    from jax.shard_map import shard_map  # type: ignore[no-redef]
+from pytorch_distributed_training_tpu.ops.dispatch import shard_map
 
 
 def gpipe_apply(
@@ -58,6 +66,8 @@ def gpipe_apply(
     *,
     axis: str = "stage",
     stream_spec: P | None = None,
+    mb_keys=None,
+    rng_impl=None,
 ):
     """Run ``layer_fn`` stacked-layer trunk over microbatches, pipelined.
 
@@ -65,7 +75,8 @@ def gpipe_apply(
         mesh: mesh whose ``axis`` dimension is the pipeline (size >= 1).
         layer_fn: ``(layer_params, x, bias) -> x`` for ONE layer, where
             ``layer_params`` is one slice of ``stacked_params`` minus the
-            leading layer dim.
+            leading layer dim. With ``mb_keys`` given, the signature is
+            ``(layer_params, x, bias, rng) -> x`` instead.
         stacked_params: pytree with leading [num_layers] dim on every
             leaf; num_layers must divide by the stage count.
         microbatches: [n_micro, mb, ...] activations entering layer 0.
@@ -75,6 +86,14 @@ def gpipe_apply(
             (applied to both ``microbatches`` and ``bias``) — e.g.
             ``P(None, ("data", "fsdp"))`` to keep the batch dim
             data-sharded through the pipeline. Default: replicated.
+        mb_keys: optional [n_micro, key_words] uint32 PRNG key data, one
+            key per microbatch (``jax.random.key_data`` of folded keys).
+            Each tick derives ``fold_in(key[mb], stage)`` and the local
+            layer scan folds in the layer index, giving every
+            (microbatch, global layer) a distinct dropout stream that the
+            backward regenerates exactly (keys are primal-deterministic).
+        rng_impl: the key impl (``jax.random.key_impl`` of the source
+            key) — required with ``mb_keys`` to rewrap the raw key data.
 
     Returns:
         [n_micro, mb, ...] activations after the last layer — identical
@@ -92,17 +111,35 @@ def gpipe_apply(
             f"need n_micro >= n_stages for a useful pipeline "
             f"(got {n_micro} < {n_stages})"
         )
+    if mb_keys is not None and rng_impl is None:
+        raise ValueError("mb_keys requires rng_impl (jax.random.key_impl)")
 
-    def local_block(params_local, x, b):
-        def body(h, lp):
-            return layer_fn(lp, h, b), None
+    def local_block(params_local, x, b, key=None):
+        if key is None:
 
-        out, _ = jax.lax.scan(body, x, params_local)
+            def body(h, lp):
+                return layer_fn(lp, h, b), None
+
+            out, _ = jax.lax.scan(body, x, params_local)
+        else:
+            layer_idx = jnp.arange(num_layers // n_stages, dtype=jnp.int32)
+
+            def body(h, lp_i):
+                lp, li = lp_i
+                return layer_fn(lp, h, b, jax.random.fold_in(key, li)), None
+
+            out, _ = jax.lax.scan(body, x, (params_local, layer_idx))
         return out
 
-    def inner(params_local, xs, biases):
+    def inner(params_local, xs, biases, *maybe_keys):
         # params_local: [L/S, ...]; xs/biases carry the FULL microbatch
         # stream on every stage (replicated) — only stage 0 reads xs.
+        from pytorch_distributed_training_tpu.ops import dispatch
+
+        with dispatch.manual_region():
+            return _inner_body(params_local, xs, biases, *maybe_keys)
+
+    def _inner_body(params_local, xs, biases, *maybe_keys):
         stage = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -116,7 +153,15 @@ def gpipe_apply(
             b = jax.lax.dynamic_index_in_dim(
                 biases, b_idx, axis=0, keepdims=False
             )
-            y = local_block(params_local, x, b)
+            key = None
+            if maybe_keys:
+                kd = jax.lax.dynamic_index_in_dim(
+                    maybe_keys[0], b_idx, axis=0, keepdims=False
+                )
+                key = jax.random.fold_in(
+                    jax.random.wrap_key_data(kd, impl=rng_impl), stage
+                )
+            y = local_block(params_local, x, b, key)
             # last stage finished microbatch t - (n_stages - 1)
             out_t = t - (n_stages - 1)
             write = jnp.logical_and(
@@ -148,28 +193,206 @@ def gpipe_apply(
 
     stream = stream_spec if stream_spec is not None else P()
     stacked_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    in_specs = [stacked_spec, stream, stream]
+    args = [stacked_params, microbatches, bias]
+    if mb_keys is not None:
+        in_specs.append(P())  # keys are tiny; replicate to every stage
+        args.append(mb_keys)
     out = shard_map(
         inner,
         mesh=mesh,
-        in_specs=(stacked_spec, stream, stream),
+        in_specs=tuple(in_specs),
         out_specs=P(axis, *stream),
         check_rep=False,
-    )(stacked_params, microbatches, bias)
+    )(*args)
     return out[-1]
 
 
-def gpipe_trunk_fn(cfg):
+def gpipe_trunk_fn(cfg, *, with_dropout: bool = False):
     """``layer_fn`` for ``gpipe_apply`` from this framework's BertLayer —
-    one post-LN encoder layer applied deterministically (models/bert.py).
-    ``cfg.remat`` wraps the layer in jax.checkpoint (GPipe's memory
-    trade)."""
+    one post-LN encoder layer (models/bert.py). ``with_dropout`` switches
+    to the 4-arg rng signature (training mode: the streamed per-(tick,
+    stage, layer) key drives the layer's dropout sites). ``cfg.remat``
+    wraps the layer in jax.checkpoint (GPipe's memory trade)."""
     from pytorch_distributed_training_tpu.models.bert import BertLayer
 
     layer = BertLayer(cfg)
 
-    def fn(layer_params, x, bias):
-        return layer.apply({"params": layer_params}, x, bias, True)
+    if with_dropout:
+
+        def fn(layer_params, x, bias, rng):
+            return layer.apply(
+                {"params": layer_params}, x, bias, False,
+                rngs={"dropout": rng},
+            )
+
+    else:
+
+        def fn(layer_params, x, bias):
+            return layer.apply({"params": layer_params}, x, bias, True)
 
     if cfg.remat:
         fn = jax.checkpoint(fn)
     return fn
+
+
+class _PoolerHead(nn.Module):
+    """Standalone wrapper registering the same ``pooler`` param subtree
+    the full model's ``pool_cls`` does (models/bert.py)."""
+
+    config: "object"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        from pytorch_distributed_training_tpu.models.bert import pool_cls
+
+        return pool_cls(self.config, x, deterministic)
+
+
+class _ClassifierHead(nn.Module):
+    """Standalone wrapper registering the same ``classifier`` subtree the
+    full model's ``classify`` does (models/bert.py)."""
+
+    config: "object"
+
+    @nn.compact
+    def __call__(self, pooled, deterministic: bool = True):
+        from pytorch_distributed_training_tpu.models.bert import classify
+
+        return classify(self.config, pooled, deterministic)
+
+
+class GPipeClassifier:
+    """``BertForSequenceClassification(scan_layers=True)`` twin whose trunk
+    runs through the GPipe schedule — the *trainable* pipeline.
+
+    init/apply-compatible with ``create_train_state`` and the shared
+    ``Trainer``: ``init`` delegates to the real flax model, so the
+    parameter tree (and therefore ``ShardingPolicy(stage=True)`` shardings,
+    orbax checkpoints, and ``models/relayout.py`` conversions) is identical
+    to the serial scan-stacked model. ``apply`` splits the batch into
+    ``n_micro`` pipeline microbatches (a pure reshape — row→microbatch
+    assignment is semantically free for a per-row loss), runs embeddings
+    outside the pipeline, streams the microbatches through
+    ``gpipe_apply`` with per-microbatch dropout keys, then applies the
+    pooler + classifier head. Mirrors the reference ConcatBert's split
+    (embeddings with stage 0, pooler/classifier after the last stage,
+    reference test_model_parallelism.py:40-89) but with the stages
+    actually overlapping and ``jax.grad`` giving the backward schedule.
+
+    Dropout caveat: flax folds RNGs per module *path*, and here each layer
+    is applied standalone — masks therefore differ from the serial model's
+    stream for the same seed (seed-level variation, same statistics). At
+    dropout 0 / deterministic the logits match the serial model exactly
+    (pinned by tests/test_pipeline.py).
+    """
+
+    def __init__(self, config, mesh: Mesh, n_micro: int,
+                 *, batch_axes=("data", "fsdp")):
+        if not config.scan_layers:
+            raise ValueError("GPipeClassifier requires scan_layers=True "
+                             "(the stage axis shards the stacked layer dim)")
+        if config.causal:
+            raise ValueError("GPipeClassifier is an encoder-classifier trunk")
+        self.config = config
+        self.mesh = mesh
+        self.n_micro = int(n_micro)
+        self.batch_axes = tuple(batch_axes)
+        from pytorch_distributed_training_tpu.models.bert import (
+            BertEmbeddings,
+            BertForSequenceClassification,
+        )
+
+        self._inner = BertForSequenceClassification(config)
+        self._emb = BertEmbeddings(config)
+        self._pool = _PoolerHead(config)
+        self._head = _ClassifierHead(config)
+
+    def init(self, rngs, *args, **kwargs):
+        return self._inner.init(rngs, *args, **kwargs)
+
+    def apply(
+        self,
+        variables,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        position_ids=None,
+        deterministic: bool = True,
+        rngs=None,
+    ):
+        from pytorch_distributed_training_tpu.models.bert import (
+            default_position_ids,
+        )
+        from pytorch_distributed_training_tpu.ops.attention import (
+            make_attention_bias,
+        )
+
+        cfg = self.config
+        n = self.n_micro
+        batch = input_ids.shape[0]
+        if batch % n:
+            raise ValueError(
+                f"micro-batch size {batch} not divisible by "
+                f"n_micro={n} pipeline microbatches"
+            )
+        dshard = 1
+        for a in self.batch_axes:
+            dshard *= self.mesh.shape.get(a, 1)
+        if (batch // n) % dshard:
+            raise ValueError(
+                f"pipeline microbatch size {batch // n} (= {batch}/{n}) "
+                f"must divide over the data axes "
+                f"({'x'.join(self.batch_axes)} = {dshard}) — lower "
+                f"n_micro or raise the micro-batch size"
+            )
+        params = variables["params"]
+        bert = params["bert"]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = default_position_ids(cfg, input_ids)
+        x = self._emb.apply(
+            {"params": bert["embeddings"]},
+            input_ids, token_type_ids, position_ids, deterministic,
+            rngs=rngs,
+        )
+        bias = make_attention_bias(attention_mask)
+        if bias is None:
+            bias = jnp.zeros((batch, 1, 1, x.shape[1]), jnp.float32)
+        xs = x.reshape(n, batch // n, *x.shape[1:])
+        biases = bias.reshape(n, batch // n, *bias.shape[1:])
+
+        dropout_on = not deterministic and (
+            cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
+        )
+        mb_keys = rng_impl = None
+        if dropout_on:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError("training with dropout needs rngs['dropout']")
+            base = rngs["dropout"]
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(n, dtype=jnp.int32)
+            )
+            mb_keys = jax.random.key_data(keys)
+            rng_impl = jax.random.key_impl(base)
+        layer_fn = gpipe_trunk_fn(cfg, with_dropout=dropout_on)
+        out = gpipe_apply(
+            self.mesh,
+            layer_fn,
+            bert["layers_scan"]["layer"],
+            xs,
+            biases,
+            stream_spec=P(None, self.batch_axes),
+            mb_keys=mb_keys,
+            rng_impl=rng_impl,
+        )
+        x = out.reshape(batch, *out.shape[2:])
+        pooled = self._pool.apply(
+            {"params": {"pooler": bert["pooler"]}}, x, deterministic,
+            rngs=rngs,
+        )
+        return self._head.apply(
+            {"params": {"classifier": params["classifier"]}},
+            pooled, deterministic, rngs=rngs,
+        )

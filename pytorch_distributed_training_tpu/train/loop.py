@@ -57,13 +57,26 @@ class Trainer:
         *,
         task: str = "auto",
         model=None,
+        model_factory=None,
         hf_checkpoint=None,
     ):
         self.mcfg = model_config
         self.tcfg = train_config
         self.info = initialize()
         self.mesh = build_mesh(mesh_config)
+        # kernels (fused LN / dal / mask-scale / flash) shard over this
+        # mesh via shard_map instead of falling back to XLA math on
+        # multi-chip runs (ops/dispatch.py; VERDICT r2 #3)
+        from pytorch_distributed_training_tpu.ops.dispatch import (
+            set_kernel_mesh,
+        )
+
+        set_kernel_mesh(self.mesh)
         self.policy = policy or ShardingPolicy()
+        if model is None and model_factory is not None:
+            # mesh-dependent models (e.g. the GPipe pipeline classifier,
+            # parallel/pipeline.py) are built here, after bootstrap + mesh
+            model = model_factory(self.mesh)
         if train_config.debug_nans:
             set_debug_nans(True)
 

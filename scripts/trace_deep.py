@@ -1,0 +1,85 @@
+"""Deep-dive an existing xprof trace (default /tmp/xprof_step): split device
+time by hlo_category, and within each category print the top op groups
+(deduplicated fusions collapsed) with total ms/step, exec count, achieved
+bytes/s, and an output-shape snippet from long_name. Pinpoints which fusions
+the generic "fusion" bucket of trace_step.py is spending time in.
+
+Usage: python scripts/trace_deep.py [tracedir] [steps]
+"""
+
+import collections
+import glob
+import gzip
+import json
+import re
+import sys
+
+
+def main():
+    tracedir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xprof_step"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    paths = glob.glob(tracedir + "/**/*.trace.json.gz", recursive=True)
+    assert paths, f"no trace under {tracedir}"
+    with gzip.open(paths[0], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    device_pids = {
+        e["pid"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "TPU" in str(e.get("args", {}).get("name", ""))
+    }
+    op_tids = {
+        (e["pid"], e["tid"])
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "thread_name"
+        and e["pid"] in device_pids
+        and "XLA Ops" in str(e.get("args", {}).get("name", ""))
+    }
+
+    cat_ms = collections.Counter()
+    group_ms = collections.Counter()
+    group_n = collections.Counter()
+    group_bytes = collections.Counter()
+    group_shape = {}
+    group_cat = {}
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        args = e.get("args", {})
+        cat = args.get("hlo_category", "?")
+        dur = e.get("dur", 0) / 1e3 / steps  # ms/step
+        cat_ms[cat] += dur
+        # group key: deduplicated fusion name if present, else the op name
+        # with trailing indices stripped
+        key = args.get("deduplicated_name") or re.sub(
+            r"[.\d]+$", "", e.get("name", "?")
+        ) or e.get("name")
+        key = f"{cat}|{key}"
+        group_ms[key] += dur
+        group_n[key] += 1
+        group_bytes[key] += int(args.get("bytes_accessed", 0) or 0)
+        group_cat[key] = cat
+        if key not in group_shape:
+            ln = args.get("long_name", "")
+            m = re.search(r"=\s*(\([^)]*\)|\S+)", ln)
+            group_shape[key] = (m.group(1) if m else ln)[:90]
+
+    total = sum(cat_ms.values())
+    print(f"device time {total:.1f} ms/step, by hlo_category:")
+    for c, ms in cat_ms.most_common():
+        print(f"  {c:28s} {ms:8.2f} ms")
+    print("\ntop 45 op groups (ms/step, n/step, GB/s achieved):")
+    for key, ms in group_ms.most_common(45):
+        n = group_n[key] // steps
+        gbs = (group_bytes[key] / steps / 1e9) / (ms / 1e3) if ms else 0
+        print(
+            f"  {ms:7.2f} ms x{n:<5d} {gbs:7.0f} GB/s "
+            f"[{group_cat[key][:14]:14s}] {group_shape[key]}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -45,13 +45,20 @@ os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
 # The axon sitecustomize registers its PJRT plugin at interpreter startup
 # (before any conftest can run), so clearing env vars is not enough — we also
 # flip the already-imported jax to CPU and reset its backend cache.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# PDT_TPU_TESTS=1 inverts the setup: the backend is left on the real chip
+# and only the ``@pytest.mark.tpu`` tier runs — the kernel paths the CPU
+# suite can't see (pltpu.prng_random_bits is all-zeros in interpret mode;
+# NOTES.md). Usage: PDT_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+_TPU_TIER = os.environ.get("PDT_TPU_TESTS") == "1"
+if not _TPU_TIER:
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
@@ -74,23 +81,43 @@ if _WANT_CACHE:
 else:
     jax.config.update("jax_compilation_cache_dir", None)
 
-jax.config.update("jax_platforms", "cpu")
-# Private API, required to un-register the axon backend that sitecustomize
-# already installed. Guarded so a future jax rename fails with a clear message.
-try:
-    import jax._src.xla_bridge as _xb  # noqa: E402
+if not _TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
+    # Private API, required to un-register the axon backend sitecustomize
+    # already installed. Guarded so a jax rename fails with a clear message.
+    try:
+        import jax._src.xla_bridge as _xb  # noqa: E402
 
-    _xb._clear_backends()
-except (ImportError, AttributeError) as e:  # pragma: no cover
-    raise RuntimeError(
-        "jax private API _clear_backends moved (jax upgrade?); update conftest"
-    ) from e
-if len(jax.devices()) != 8:  # pragma: no cover - depends on launch env
-    raise RuntimeError(
-        f"conftest failed to set up the 8-device CPU mesh (got {jax.devices()})"
-    )
+        _xb._clear_backends()
+    except (ImportError, AttributeError) as e:  # pragma: no cover
+        raise RuntimeError(
+            "jax private API _clear_backends moved (jax upgrade?); "
+            "update conftest"
+        ) from e
+    if len(jax.devices()) != 8:  # pragma: no cover - depends on launch env
+        raise RuntimeError(
+            f"conftest failed to set up the 8-device CPU mesh "
+            f"(got {jax.devices()})"
+        )
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """tpu-marked tests run only on the real chip (PDT_TPU_TESTS=1 tier);
+    everything else runs only on the CPU mesh — one suite, two tiers."""
+    skip_tpu = pytest.mark.skip(
+        reason="on-TPU tier: run with PDT_TPU_TESTS=1 -m tpu on the chip"
+    )
+    skip_cpu = pytest.mark.skip(
+        reason="CPU-mesh test: run without PDT_TPU_TESTS"
+    )
+    for item in items:
+        is_tpu = "tpu" in item.keywords
+        if is_tpu and not _TPU_TIER:
+            item.add_marker(skip_tpu)
+        elif not is_tpu and _TPU_TIER:
+            item.add_marker(skip_cpu)
 
 
 @pytest.fixture(scope="session")
@@ -100,3 +127,18 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
     return devices
+
+
+@pytest.fixture(autouse=True)
+def _clear_kernel_dispatch_ctx():
+    """A Trainer registers its mesh as the global kernel-dispatch context
+    (ops/dispatch.py) and that registration intentionally outlives it in a
+    real process; between TESTS it must not leak (an interpret-mode parity
+    test after a Trainer test would silently shard_map over the stale
+    mesh)."""
+    yield
+    from pytorch_distributed_training_tpu.ops.dispatch import (
+        set_kernel_mesh,
+    )
+
+    set_kernel_mesh(None)

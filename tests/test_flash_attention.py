@@ -43,16 +43,14 @@ def test_interpret_probe_sees_context():
     """The dispatch guard must recognize the framework's interpret-mode
     context — otherwise every parity test below would silently compare
     reference to itself."""
-    from pytorch_distributed_training_tpu.ops.flash_attention import (
-        _flash_backend_ok,
-    )
+    from pytorch_distributed_training_tpu.ops import dispatch
 
     import jax
 
     if jax.default_backend() != "tpu":
-        assert not _flash_backend_ok()
+        assert dispatch.mode() == "off"
     with tpu_interpret_mode():
-        assert _flash_backend_ok()
+        assert dispatch.mode() == "direct"
 
 
 @pytest.mark.parametrize("causal", [False, True])
