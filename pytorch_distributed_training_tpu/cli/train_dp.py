@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", default=None,
                    help="attention impl: reference | flash | ring "
                         "(default: preset's; ring when --mesh-seq > 1)")
+    p.add_argument("--matmul-impl", default="native",
+                   choices=("native", "int8", "int8_full"),
+                   help="dense-matmul path (ops/quant.py): int8 runs the "
+                        "MXU's 2x-rate int8 tier with dynamic quantization")
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction,
                    default=False, help="shard params/opt state over fsdp axis")
     p.add_argument("--mesh-data", type=int, default=-1)
@@ -73,6 +77,7 @@ def main(argv=None) -> list[dict]:
     mcfg = model_preset(
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
+        matmul_impl=args.matmul_impl,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(

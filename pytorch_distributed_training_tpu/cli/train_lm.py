@@ -39,6 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--scan-layers", action=argparse.BooleanOptionalAction,
                    default=True)
+    p.add_argument("--remat", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="jax.checkpoint each block (bigger micro batches)")
+    p.add_argument("--remat-policy", default="nothing",
+                   choices=("nothing", "dots", "weight_dots"),
+                   help="what remat saves: nothing = full recompute; dots = "
+                        "save matmul outputs, recompute the elementwise tail")
     p.add_argument("--mesh-data", type=int, default=1)
     p.add_argument("--mesh-fsdp", type=int, default=-1)
     p.add_argument("--mesh-model", type=int, default=1)
@@ -57,6 +64,7 @@ def main(argv=None) -> list[dict]:
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         scan_layers=args.scan_layers,
+        remat=args.remat, remat_policy=args.remat_policy,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     if not mcfg.causal:

@@ -113,6 +113,7 @@ def main(argv=None) -> list[dict]:
             def model_factory(
                 mesh, _cfg=mcfg, _n=args.pipeline_microbatches,
                 _micro=tcfg.micro_batch_size,
+                _eval=tcfg.eval_batch_size,
             ):
                 # auto n_micro: deepest stream that still leaves each
                 # pipeline microbatch divisible over the data axes (GPipe
@@ -123,22 +124,33 @@ def main(argv=None) -> list[dict]:
                 dshard = mesh.shape["data"] * mesh.shape["fsdp"]
                 if _n <= 0:
                     for cand in (4 * stages, 2 * stages, stages):
-                        if _micro % cand == 0 and (_micro // cand) % dshard == 0:
+                        if all(
+                            b % cand == 0 and (b // cand) % dshard == 0
+                            for b in (_micro, _eval)
+                        ):
                             _n = cand
                             break
                     else:
                         raise SystemExit(
                             f"no pipeline microbatch count in "
                             f"{{4,2,1}}x{stages} divides micro-batch "
-                            f"{_micro} with per-microbatch batch divisible "
-                            f"by data*fsdp={dshard}; pick sizes explicitly"
+                            f"{_micro} AND eval-batch {_eval} with "
+                            f"per-microbatch batch divisible by "
+                            f"data*fsdp={dshard}; pick sizes explicitly"
                         )
-                if _micro % _n or (_micro // _n) % dshard:
-                    raise SystemExit(
-                        f"--pipeline-microbatches {_n}: micro-batch "
-                        f"{_micro} must split into {_n} microbatches whose "
-                        f"size divides data*fsdp={dshard}"
-                    )
+                for bname, bsz in (
+                    ("micro-batch", _micro),
+                    # evaluate() streams eval batches through the SAME
+                    # pipelined model — catch a bad eval size up front, not
+                    # after a full training epoch
+                    ("eval-batch", _eval),
+                ):
+                    if bsz % _n or (bsz // _n) % dshard:
+                        raise SystemExit(
+                            f"--pipeline-microbatches {_n}: {bname} "
+                            f"{bsz} must split into {_n} microbatches whose "
+                            f"size divides data*fsdp={dshard}"
+                        )
                 return GPipeClassifier(_cfg, mesh, _n)
 
     trainer = Trainer(

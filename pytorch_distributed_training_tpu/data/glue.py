@@ -7,10 +7,11 @@ Capability twin of the reference's data pipeline: ``load_dataset("glue",
 re-padding every batch in a collate_fn (:95-99) — on TPU one shape means one
 compiled program.
 
-Tasks: MRPC (the reference's task) and MNLI (driver config, BASELINE.json
-configs[3]). When the HF hub/cache is unreachable (this image), falls back to
-the synthetic pair task with MRPC-shaped splits so every entry point still
-runs end-to-end.
+Tasks: MRPC (the reference's task), MNLI (driver config, BASELINE.json
+configs[3]; both matched and mismatched validation splits), SST-2
+(single-sentence), and QNLI. When the HF hub/cache is unreachable (this
+image), falls back to the synthetic pair task with MRPC-shaped splits so
+every entry point still runs end-to-end.
 """
 
 from __future__ import annotations
@@ -31,10 +32,27 @@ TASKS = {
     # task: (dataset args, text field a, text field b, num_labels)
     "mrpc": (("glue", "mrpc"), "sentence1", "sentence2", 2),
     "mnli": (("glue", "mnli"), "premise", "hypothesis", 3),
+    # single-sentence task: field b is None (encoders emit [CLS] a [SEP])
+    "sst2": (("glue", "sst2"), "sentence", None, 2),
+    "qnli": (("glue", "qnli"), "question", "sentence", 2),
     "synthetic": (None, None, None, 2),
     # causal-LM corpus (synthetic Markov chain; BASELINE.json configs[4])
     "lm": (None, None, None, 0),
 }
+
+
+def eval_splits(task: str) -> list[tuple[str, str]]:
+    """(metric name suffix, split) pairs a trainer should evaluate.
+
+    MNLI's standard eval is BOTH validation splits — matched (same genres as
+    train) and mismatched (held-out genres); reference anchor
+    test_data_parallelism.py:70 (the task arg the metric follows). Every
+    other task has the single ``"validation"`` split; its suffix is empty so
+    metric keys stay unprefixed ("accuracy", not "accuracy_validation").
+    """
+    if task == "mnli":
+        return [("matched", "validation"), ("mismatched", "validation_mismatched")]
+    return [("", "validation")]
 
 
 def make_tokenizer(vocab_path: Optional[str] = None, vocab_size: int = 28996):
@@ -74,8 +92,10 @@ def load_task_arrays(
 ) -> tuple[dict[str, np.ndarray], int]:
     """Return ({input_ids, attention_mask, token_type_ids, labels}, num_labels).
 
-    ``split`` is "train" or "validation". ``task="auto"`` tries MRPC and
-    falls back to synthetic when the hub/cache is unavailable.
+    ``split`` is "train", "validation", or (MNLI only)
+    "validation_mismatched"; "validation" maps to MNLI's
+    ``validation_matched``. ``task="auto"`` tries MRPC and falls back to
+    synthetic when the hub/cache is unavailable.
     """
     if task == "auto":
         task = resolve_task(task)
@@ -110,6 +130,8 @@ def load_task_arrays(
     hub_split = split
     if task == "mnli" and split == "validation":
         hub_split = "validation_matched"
+    if split == "validation_mismatched" and task != "mnli":
+        raise ValueError(f"task {task!r} has no mismatched validation split")
     try:
         ds = datasets.load_dataset(*ds_args, split=hub_split)
     except (ConnectionError, TimeoutError, OSError) as e:
@@ -123,10 +145,19 @@ def load_task_arrays(
         )
         n_train, n_eval = synthetic_sizes
         n = n_train if split == "train" else n_eval
+        # distinct seed per split: train / validation / validation_mismatched
+        # must be three different samples of the synthetic task (any other
+        # split string keeps the old eval-seed behavior, matching the hub
+        # path's tolerance of arbitrary split names)
+        split_seed = {
+            "train": seed,
+            "validation": seed + 1,
+            "validation_mismatched": seed + 2,
+        }.get(split, seed + 1)
         data = synthetic.synthetic_pair_task(
             n, max_length=max_length, vocab_size=vocab_size,
             num_labels=num_labels,
-            seed=seed if split == "train" else seed + 1,
+            seed=split_seed,
         )
         return data, num_labels
     arrays = None
@@ -144,7 +175,8 @@ def load_task_arrays(
             enc = NativeWordPieceEncoder(vocab_path)
             try:
                 arrays = enc.encode_pairs(
-                    list(ds[field_a]), list(ds[field_b]),
+                    list(ds[field_a]),
+                    list(ds[field_b]) if field_b else None,
                     max_length=max_length,
                 )
             finally:
@@ -153,7 +185,8 @@ def load_task_arrays(
     if arrays is None:
         tokenizer = make_tokenizer(vocab_path, vocab_size)
         arrays = encode_pairs(
-            tokenizer, ds[field_a], ds[field_b], max_length=max_length
+            tokenizer, ds[field_a], ds[field_b] if field_b else None,
+            max_length=max_length,
         )
     arrays["labels"] = np.asarray(ds["label"], np.int32)
     return arrays, num_labels

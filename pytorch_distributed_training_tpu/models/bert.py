@@ -87,6 +87,29 @@ class BertEmbeddings(nn.Module):
         )
 
 
+def dense_general(cfg: ModelConfig, features, axis, name, kw):
+    """nn.DenseGeneral or its int8-MXU twin (ops/quant.py), switched by
+    ``cfg.matmul_impl``. Parameter layout is identical either way, so the
+    switch never touches checkpoints or the HF loader."""
+    if cfg.matmul_impl == "native":
+        return nn.DenseGeneral(features, axis=axis, name=name, **kw)
+    if cfg.matmul_impl not in ("int8", "int8_full"):
+        raise ValueError(
+            f"matmul_impl must be native/int8/int8_full, got "
+            f"{cfg.matmul_impl!r}"
+        )
+    from pytorch_distributed_training_tpu.ops.quant import QuantDenseGeneral
+
+    feats = features if isinstance(features, tuple) else (features,)
+    ax = axis if isinstance(axis, tuple) else (axis,)
+    return QuantDenseGeneral(
+        features=feats, axis=ax,
+        mode="full" if cfg.matmul_impl == "int8_full" else "fwd",
+        dtype=kw["dtype"], param_dtype=kw["param_dtype"],
+        kernel_init=kw["kernel_init"], name=name,
+    )
+
+
 class BertSelfAttention(nn.Module):
     config: ModelConfig
 
@@ -100,9 +123,9 @@ class BertSelfAttention(nn.Module):
         # three column matmuls + their consumers better than one wide one
         # followed by slices; tried 2026-07, see NOTES.md).
         heads_shape = (cfg.num_heads, cfg.head_dim)
-        q = nn.DenseGeneral(heads_shape, axis=-1, name="query", **kw)(x)
-        k = nn.DenseGeneral(heads_shape, axis=-1, name="key", **kw)(x)
-        v = nn.DenseGeneral(heads_shape, axis=-1, name="value", **kw)(x)
+        q = dense_general(cfg, heads_shape, -1, "query", kw)(x)
+        k = dense_general(cfg, heads_shape, -1, "key", kw)(x)
+        v = dense_general(cfg, heads_shape, -1, "value", kw)(x)
         if cfg.decode:
             out = self._cached_attend(q, k, v, attention_bias)
         else:
@@ -131,9 +154,7 @@ class BertSelfAttention(nn.Module):
                 # backward structure, so only the XLA einsum impl opts in.
                 core = jax.checkpoint(core)
             out = core(q, k, v, attention_bias, dropout_rng)
-        return nn.DenseGeneral(
-            cfg.hidden_size, axis=(-2, -1), name="out", **kw
-        )(out)
+        return dense_general(cfg, cfg.hidden_size, (-2, -1), "out", kw)(out)
 
     def _cached_attend(self, q, k, v, attention_bias):
         """Autoregressive attention over the KV cache (generation path).
@@ -219,9 +240,9 @@ class BertLayer(nn.Module):
         )
         x = tail("attention_norm", 0)(attn_out, x, deterministic)
 
-        h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(x)
+        h = dense_general(cfg, cfg.intermediate_size, -1, "mlp_up", kw)(x)
         h = nn.gelu(h, approximate=cfg.gelu_approximate)
-        h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
+        h = dense_general(cfg, cfg.hidden_size, -1, "mlp_down", kw)(h)
         return tail("mlp_norm", 1)(h, x, deterministic)
 
 
@@ -245,11 +266,32 @@ def default_position_ids(cfg: ModelConfig, input_ids):
     )
 
 
+def remat_policy(cfg: ModelConfig):
+    """Map ``cfg.remat_policy`` to a ``jax.checkpoint`` policy (None =
+    save nothing = classic full remat). Shared by both model families."""
+    name = getattr(cfg, "remat_policy", "nothing")
+    if name == "nothing":
+        return None
+    import jax
+
+    policies = {
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "weight_dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"remat_policy must be nothing/dots/weight_dots, got {name!r}"
+        )
+    return policies[name]
+
+
 def _layer_cls(cfg: ModelConfig):
     """BertLayer, remat-wrapped when configured — the ONE place the
     nn.remat/static_argnums contract with BertLayer.__call__ is encoded."""
     if cfg.remat:
-        return nn.remat(BertLayer, static_argnums=(3,))
+        return nn.remat(
+            BertLayer, static_argnums=(3,), policy=remat_policy(cfg)
+        )
     return BertLayer
 
 

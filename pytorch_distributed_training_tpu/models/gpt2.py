@@ -25,6 +25,7 @@ from pytorch_distributed_training_tpu.models.bert import (
     _dtype,
     _ln,
     _pdtype,
+    dense_general,
 )
 from pytorch_distributed_training_tpu.ops.attention import make_attention_bias
 from pytorch_distributed_training_tpu.ops.dropout import Dropout
@@ -50,9 +51,9 @@ class GPT2Block(nn.Module):
         x = x + h
 
         h = _ln(cfg, "ln_2")(x)
-        h = nn.Dense(cfg.intermediate_size, name="mlp_up", **kw)(h)
+        h = dense_general(cfg, cfg.intermediate_size, -1, "mlp_up", kw)(h)
         h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
-        h = nn.Dense(cfg.hidden_size, name="mlp_down", **kw)(h)
+        h = dense_general(cfg, cfg.hidden_size, -1, "mlp_down", kw)(h)
         h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(h, deterministic=deterministic)
         return x + h
 
@@ -62,7 +63,11 @@ def _gpt2_layer_cls(cfg: ModelConfig):
     contract as bert._layer_cls (GPT2Block.__call__ shares BertLayer's
     signature, with ``deterministic`` at position 3)."""
     if cfg.remat:
-        return nn.remat(GPT2Block, static_argnums=(3,))
+        from pytorch_distributed_training_tpu.models.bert import remat_policy
+
+        return nn.remat(
+            GPT2Block, static_argnums=(3,), policy=remat_policy(cfg)
+        )
     return GPT2Block
 
 

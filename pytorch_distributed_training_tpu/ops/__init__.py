@@ -2,5 +2,16 @@ from pytorch_distributed_training_tpu.ops.attention import (
     ATTENTION_IMPLS,
     dot_product_attention,
 )
+from pytorch_distributed_training_tpu.ops.quant import (
+    QuantDenseGeneral,
+    int8_dense,
+    int8_matmul,
+)
 
-__all__ = ["ATTENTION_IMPLS", "dot_product_attention"]
+__all__ = [
+    "ATTENTION_IMPLS",
+    "QuantDenseGeneral",
+    "dot_product_attention",
+    "int8_dense",
+    "int8_matmul",
+]

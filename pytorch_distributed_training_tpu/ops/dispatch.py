@@ -101,12 +101,19 @@ def interpret_active() -> bool:
 
 try:  # single home for the shard_map import (new API first)
     from jax import shard_map as _shard_map_impl  # type: ignore[attr-defined]
-
-    _CHECK_KW = "check_vma"  # jax >= 0.8 renamed check_rep
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 
-    _CHECK_KW = "check_rep"
+# The check_rep -> check_vma rename is independent of WHERE shard_map is
+# importable from (jax versions exist with the top-level export and the old
+# kwarg), so gate on the actual signature, not the import location.
+import inspect as _inspect
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_rep=True):

@@ -188,16 +188,6 @@ def _fused_vjp_bwd(eps, out_dtype, block_r, res, dy):
 _fused_layer_norm.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
 
 
-def _backend_ok() -> bool:
-    """Direct (un-shard_mapped) kernel dispatch: single-device TPU or the
-    interpret context. Sharded meshes route through shard_map instead
-    (ops/dispatch.py) — never a bare custom call under GSPMD, which would
-    all-gather the sharded activations per call."""
-    from pytorch_distributed_training_tpu.ops import dispatch
-
-    return dispatch.mode() == "direct"
-
-
 from pytorch_distributed_training_tpu.ops.dispatch import (
     shard_map as _shard_map,
 )

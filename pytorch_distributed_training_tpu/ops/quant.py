@@ -1,0 +1,236 @@
+"""int8 MXU matmul path (dynamic-quantized dense layers).
+
+Why this exists: the v5e MXU executes int8×int8→int32 at twice its bf16
+FLOP rate (≈394 vs ≈197 T/s). On the bert-large MRPC recipe the step time
+is ~85% near-peak bf16 matmul (NOTES.md round-3 ledger), so once the
+elementwise/optimizer tail is shaved there is structurally NOTHING left to
+win in bf16 — the remaining lever the hardware offers is the int8 systolic
+path. This module implements it as dynamic quantization around
+``lax.dot_general``:
+
+- weights: per-output-channel scales (absmax / 127), quantized once per
+  step (loop-invariant across the accumulation microbatches — XLA CSEs the
+  quantize of an unchanging operand in the unrolled accumulation graph);
+- activations: one dynamic per-tensor scale per microbatch (absmax / 127).
+  Per-tensor (not per-row) so the SAME quantized tensor stays valid for any
+  contraction axis;
+- products accumulate in int32 on the MXU, then one fused rescale
+  ``* (sx * sw)`` lands the result back in the compute dtype.
+
+The backward is a straight-through estimator: rounding is treated as
+identity, and the two backward matmuls run against the QUANTIZED (then
+dequantized) operands — the true gradient of the quantized forward, modulo
+the STE step. ``QuantMode`` picks how the backward matmuls themselves
+execute:
+
+- ``"fwd"``  — backward in bf16 (dgrad/wgrad full precision). ~⅓ of the
+  dot FLOPs go 2×; the gradient path keeps full mantissa.
+- ``"full"`` — dgrad and wgrad also int8, with fresh dynamic per-tensor
+  scales for ``dy``. Fastest; gradient quantization noise is the price.
+
+This is an OPT-IN config (``ModelConfig.matmul_impl="int8"``), never a
+silent default: convergence must be demonstrated per-recipe (see
+NOTES.md int8 section for the on-chip A/B protocol) before any benchmark
+reports it. The reference has no analogue (its AMP is fp16,
+test_data_parallelism.py:55); this is TPU-hardware-first design, not
+parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_INT8_MAX = 127.0
+
+
+def _absmax(x, axes, keepdims=True):
+    m = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes, keepdims=keepdims)
+    # guard all-zero tensors: scale 0 would produce NaN on dequant
+    return jnp.maximum(m, 1e-12)
+
+
+def quantize_per_tensor(x):
+    """→ (int8 tensor, fp32 scalar scale). x ≈ q * scale."""
+    scale = _absmax(x, axes=None, keepdims=False) / _INT8_MAX
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def quantize_per_channel(w, contract_axis):
+    """→ (int8 weight, fp32 per-output-channel scale broadcastable against
+    the matmul result). ``contract_axis`` is the axis being contracted away
+    (reduced over when taking absmax)."""
+    scale = _absmax(w, axes=contract_axis) / _INT8_MAX
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), jnp.squeeze(scale, axis=contract_axis)
+
+
+def _fwd_dims(x_ndim: int, n_contract: int):
+    """Forward dot dims: x's trailing ``n_contract`` axes against the
+    kernel's leading ``n_contract`` axes (DenseGeneral contraction)."""
+    nb = x_ndim - n_contract
+    return (
+        (tuple(range(nb, x_ndim)), tuple(range(n_contract))),
+        ((), ()),
+    )
+
+
+def _quantized_dot(x, kernel, n_contract):
+    """Shared quantize → int8 dot → rescale body, on NATIVE shapes — no
+    2-D reshape: an explicit reshape of an int8 (32,128)-tiled array is a
+    materialized relayout copy on TPU (measured ~7 ms/step of pure copies
+    on the bert-large recipe before this was dims-based). Returns the
+    result in ``x``'s dtype plus the quantized operands/scales (the
+    custom-VJP residuals; the primal drops them). ONE implementation so
+    the primal and the VJP forward cannot diverge."""
+    xq, sx = quantize_per_tensor(x)
+    wq, sw = quantize_per_channel(
+        kernel, contract_axis=tuple(range(n_contract))
+    )  # sw: [f1..fm]
+    acc = lax.dot_general(
+        xq, wq, _fwd_dims(x.ndim, n_contract),
+        preferred_element_type=jnp.int32,
+    )
+    y = (acc.astype(jnp.float32) * (sx * sw)).astype(x.dtype)
+    return y, (xq, sx, wq, sw)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def int8_dense(x, kernel, n_contract: int = 1, mode: str = "fwd"):
+    """Quantized DenseGeneral contraction with an STE backward; the result
+    and the activation cotangent keep ``x``'s dtype.
+
+    ``x``: [b1..bk, c1..cn]; ``kernel``: [c1..cn, f1..fm] → [b1..bk, f1..fm].
+
+    ``mode="fwd"``: int8 forward, full-precision backward.
+    ``mode="full"``: int8 forward AND int8 dgrad/wgrad.
+    """
+    return _quantized_dot(x, kernel, n_contract)[0]
+
+
+def _int8_dense_fwd(x, kernel, n_contract, mode):
+    y, (xq, sx, wq, sw) = _quantized_dot(x, kernel, n_contract)
+    # save the QUANTIZED operands: the backward then differentiates the
+    # function the forward actually computed (STE through the rounding),
+    # and int8 residuals are 2-4x smaller in HBM than the bf16 inputs.
+    # Zero-size sentinels carry the primal dtypes (dtype objects are not
+    # pytree leaves; cotangents must come back in exactly these dtypes).
+    sent = (jnp.zeros((0,), x.dtype), jnp.zeros((0,), kernel.dtype))
+    return y, (xq, sx, wq, sw, sent)
+
+
+def _int8_dense_bwd(n_contract, mode, res, dy):
+    xq, sx, wq, sw, sent = res
+    x_dtype, w_dtype = sent[0].dtype, sent[1].dtype
+    nb = xq.ndim - n_contract  # batch rank
+    nf = wq.ndim - n_contract  # feature rank
+    # dx[b.., c..] = dy[b.., f..] · kernel[c.., f..]^T : contract f-dims
+    dx_dims = (
+        (tuple(range(nb, nb + nf)), tuple(range(n_contract, wq.ndim))),
+        ((), ()),
+    )
+    # dw[c.., f..] = x[b.., c..]^T · dy[b.., f..] : contract batch dims
+    dw_dims = ((tuple(range(nb)), tuple(range(nb))), ((), ()))
+    if mode == "full":
+        # sw varies along dx's CONTRACTED f-dims — fold it into dy BEFORE
+        # quantizing so one dynamic per-tensor scale stays exact
+        dy_scaled = dy.astype(jnp.float32) * sw  # broadcasts over [f..]
+        dyq2, sdy2 = quantize_per_tensor(dy_scaled)
+        dx = (
+            lax.dot_general(
+                dyq2, wq, dx_dims, preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * sdy2
+        ).astype(x_dtype)
+        # per-tensor scales factor straight out of the batch contraction
+        dyq, sdy = quantize_per_tensor(dy)
+        dw = (
+            lax.dot_general(
+                xq, dyq, dw_dims, preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * (sx * sdy)
+        ).astype(w_dtype)
+        return dx, dw
+    xdq = (xq.astype(jnp.float32) * sx).astype(x_dtype)
+    wdq = (wq.astype(jnp.float32) * sw).astype(x_dtype)
+    dx = lax.dot_general(
+        dy.astype(x_dtype), wdq, dx_dims,
+        preferred_element_type=jnp.float32,
+    ).astype(x_dtype)
+    dw = lax.dot_general(
+        xdq, dy.astype(x_dtype), dw_dims,
+        preferred_element_type=jnp.float32,
+    ).astype(w_dtype)
+    return dx, dw
+
+
+int8_dense.defvjp(_int8_dense_fwd, _int8_dense_bwd)
+
+
+def int8_matmul(x2d, w2d, mode: str = "fwd"):
+    """2-D convenience wrapper over :func:`int8_dense` ([T,K]·[K,N])."""
+    return int8_dense(x2d, w2d, 1, mode)
+
+
+def quant_dense_apply(x, kernel, bias, *, n_contract: int, mode: str,
+                      out_dtype):
+    """DenseGeneral-compatible apply through the int8 path.
+
+    ``x``: [..., c1..cn] with the last ``n_contract`` axes contracted;
+    ``kernel``: [c1..cn, f1..fm]; ``bias``: [f1..fm] or None. Contraction
+    happens on the native shapes (see ``_quantized_dot`` on why there is
+    deliberately no 2-D reshape here).
+    """
+    y = int8_dense(x, kernel, n_contract, mode).astype(out_dtype)
+    if bias is not None:
+        y = y + bias.astype(out_dtype)
+    return y
+
+
+# --------------------------------------------------------------------- flax
+import flax.linen as nn  # noqa: E402  (module-level layer, keeps parity with
+#                          ops/layer_norm.py's FusedDropoutAddLayerNorm home)
+
+
+class QuantDenseGeneral(nn.Module):
+    """Drop-in ``nn.DenseGeneral`` running its matmul on the int8 MXU path.
+
+    Parameter names/shapes/init are IDENTICAL to ``nn.DenseGeneral``
+    (kernel = [*contracted input dims, *features], bias = [*features]) so
+    checkpoints and the HF weight loader are layout-agnostic: a model can
+    be trained int8 and evaluated bf16 or vice versa by flipping
+    ``ModelConfig.matmul_impl`` alone.
+    """
+
+    features: tuple  # output feature dims (tuple, possibly length 1)
+    axis: tuple = (-1,)  # contracted input axes
+    mode: str = "fwd"  # int8_matmul mode: "fwd" | "full"
+    use_bias: bool = True
+    dtype: object = jnp.bfloat16
+    param_dtype: object = jnp.float32
+    kernel_init: object = nn.initializers.lecun_normal()
+    bias_init: object = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        axis = tuple(a % x.ndim for a in self.axis)
+        if axis != tuple(range(x.ndim - len(axis), x.ndim)):
+            raise ValueError(
+                f"QuantDenseGeneral contracts trailing axes only, got {self.axis}"
+            )
+        in_shape = tuple(x.shape[a] for a in axis)
+        kernel = self.param(
+            "kernel", self.kernel_init, (*in_shape, *self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param("bias", self.bias_init, self.features, self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        return quant_dense_apply(
+            x, kernel, bias, n_contract=len(axis), mode=self.mode,
+            out_dtype=self.dtype,
+        )

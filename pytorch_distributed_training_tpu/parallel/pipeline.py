@@ -114,6 +114,15 @@ def gpipe_apply(
     if mb_keys is not None and rng_impl is None:
         raise ValueError("mb_keys requires rng_impl (jax.random.key_impl)")
 
+    # mesh axes the microbatch stream is sharded over (for per-shard
+    # dropout-key folding inside the manual region)
+    shard_axes: tuple = ()
+    if stream_spec is not None:
+        for entry in stream_spec:
+            if entry is None:
+                continue
+            shard_axes += entry if isinstance(entry, tuple) else (entry,)
+
     def local_block(params_local, x, b, key=None):
         if key is None:
 
@@ -161,6 +170,15 @@ def gpipe_apply(
                 key = jax.random.fold_in(
                     jax.random.wrap_key_data(kd, impl=rng_impl), stage
                 )
+                if shard_axes:
+                    # the microbatch stream is data-sharded (stream_spec):
+                    # every shard must draw a DISTINCT dropout stream, same
+                    # contract as every ops/dispatch shard_map wrapper
+                    from pytorch_distributed_training_tpu.ops import dispatch
+
+                    key = jax.random.fold_in(
+                        key, dispatch.linear_device_index(shard_axes, mesh)
+                    )
             y = local_block(params_local, x, b, key)
             # last stage finished microbatch t - (n_stages - 1)
             out_t = t - (n_stages - 1)

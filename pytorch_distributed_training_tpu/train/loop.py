@@ -108,30 +108,38 @@ class Trainer:
             seed=train_config.seed,
             synthetic_sizes=sizes,
         )
-        eval_data, _ = load_task_arrays(
-            task, "validation",
-            max_length=train_config.max_seq_length,
-            vocab_path=train_config.vocab_path,
-            vocab_size=model_config.vocab_size,
-            seed=train_config.seed,
-            synthetic_sizes=sizes,
-        )
+        from pytorch_distributed_training_tpu.data.glue import eval_splits
+
+        eval_datas = {}  # suffix -> arrays (MNLI evaluates both val splits)
+        for suffix, split in eval_splits(task):
+            eval_datas[suffix], _ = load_task_arrays(
+                task, split,
+                max_length=train_config.max_seq_length,
+                vocab_path=train_config.vocab_path,
+                vocab_size=model_config.vocab_size,
+                seed=train_config.seed,
+                synthetic_sizes=sizes,
+            )
         if train_config.train_size:
             train_data = {
                 k: v[: train_config.train_size] for k, v in train_data.items()
             }
         if train_config.eval_size:
-            eval_data = {
-                k: v[: train_config.eval_size] for k, v in eval_data.items()
+            eval_datas = {
+                s: {k: v[: train_config.eval_size] for k, v in d.items()}
+                for s, d in eval_datas.items()
             }
         if num_labels:
             self.mcfg.num_labels = num_labels
         self.train_loader = self._make_train_loader(train_data, train_config)
-        self.eval_loader = ShardedLoader(
-            eval_data, self.mesh,
-            global_batch_size=train_config.eval_batch_size,
-            train=False, seed=train_config.seed,
-        )
+        self.eval_loaders = {
+            suffix: ShardedLoader(
+                d, self.mesh,
+                global_batch_size=train_config.eval_batch_size,
+                train=False, seed=train_config.seed,
+            )
+            for suffix, d in eval_datas.items()
+        }
 
         # ----------------------------------------------------------- model
         if model is None:
@@ -346,17 +354,33 @@ class Trainer:
                 if self.checkpointer:
                     self.checkpointer.save(self.state)
 
-    def evaluate(self) -> dict:
-        if self.objective == "causal_lm":
-            from pytorch_distributed_training_tpu.train.metrics import (
-                LMMetricAccumulator,
-            )
+    @property
+    def eval_loader(self):
+        """The primary eval split's loader (the only one for every task but
+        MNLI, whose loaders are keyed "matched"/"mismatched")."""
+        return next(iter(self.eval_loaders.values()))
 
-            acc = LMMetricAccumulator()
-        else:
-            acc = MetricAccumulator(self.mcfg.num_labels)
-        for batch in self.eval_loader.epoch():
-            with annotate("eval_step"):
-                counts = self.eval_step(self.state, batch)
-            acc.update(jax.device_get(counts))
-        return acc.compute()
+    def evaluate(self) -> dict:
+        out = {}
+        for suffix, loader in self.eval_loaders.items():
+            if self.objective == "causal_lm":
+                from pytorch_distributed_training_tpu.train.metrics import (
+                    LMMetricAccumulator,
+                )
+
+                acc = LMMetricAccumulator()
+            else:
+                acc = MetricAccumulator(self.mcfg.num_labels)
+            for batch in loader.epoch():
+                with annotate("eval_step"):
+                    counts = self.eval_step(self.state, batch)
+                acc.update(jax.device_get(counts))
+            raw = acc.compute()
+            # first (primary) split also keeps unprefixed keys so existing
+            # consumers (tests, HISTORY artifacts) read the same fields
+            if not out and suffix:
+                out.update(raw)
+            out.update(
+                {f"{k}_{suffix}": v for k, v in raw.items()} if suffix else raw
+            )
+        return out

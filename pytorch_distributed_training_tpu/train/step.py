@@ -199,13 +199,10 @@ def make_train_step(
         single_step = train_step
 
         def train_step(state: TrainState, batches):  # noqa: F811
-            def body(st, b):
-                st, m = single_step(st, b)
-                return st, tuple(m[k] for k in sorted(m))
-
-            state, stacked = jax.lax.scan(body, state, batches)
-            keys = sorted(["loss"] + (["grad_norm"] if log_grad_norm else []))
-            return state, {k: v[-1] for k, v in zip(keys, stacked)}
+            # scan carries the metrics DICT as a pytree — no parallel key
+            # list to keep in sync with whatever single_step emits
+            state, stacked = jax.lax.scan(single_step, state, batches)
+            return state, {k: v[-1] for k, v in stacked.items()}
 
     donate = (0,)
     if mesh is None:

@@ -99,6 +99,12 @@ class ModelConfig:
     # "reference" (XLA einsum) | "flash" (Pallas kernel, ops/flash_attention)
     # | "ring" (sequence-parallel, ops/ring_attention)
     attention_impl: str = "reference"
+    # Dense-matmul execution path (ops/quant.py): "native" = XLA matmuls in
+    # compute_dtype; "int8" = dynamic-quantized int8 forward on the MXU's
+    # 2x-rate int8 path with a bf16 straight-through backward; "int8_full" =
+    # int8 dgrad/wgrad too. OPT-IN — convergence must be demonstrated
+    # per-recipe before a benchmark reports it (NOTES.md int8 section).
+    matmul_impl: str = "native"
     # Dropout mask generator (ops/dropout.py): "kernel" draws the keep mask
     # from the per-core TPU PRNG inside a Pallas op (only the x-dtype
     # mask-scale tensor touches HBM; falls back to bits32 off-TPU);
@@ -127,6 +133,19 @@ class ModelConfig:
     # match either way.
     gelu_approximate: bool = True
     remat: bool = False  # jax.checkpoint each layer (trade FLOPs for HBM)
+    # What the per-layer remat SAVES (only read when remat=True):
+    #   "nothing"  — classic full remat: recompute the whole layer in the
+    #                backward (max memory savings, ~2x layer FLOPs);
+    #   "dots"     — selective remat: save every matmul/einsum output,
+    #                recompute only the cheap elementwise tail (LN, gelu,
+    #                dropout masks regenerate from their counter streams).
+    #                Matmul FLOPs stay 1x — this is what unlocks larger
+    #                microbatches on the LM recipes without paying full
+    #                recompute (VERDICT r2 #5);
+    #   "weight_dots" — save only the UNBATCHED dots (xW projections/MLP),
+    #                recompute the batched attention-score einsums too —
+    #                between the other two in both memory and FLOPs.
+    remat_policy: str = "nothing"
     # Rematerialize the attention core (scores/softmax/probs) in the
     # backward pass instead of saving probs residuals — a strict win on the
     # seq-128 encoder recipe (see models/bert.py); applies to the

@@ -34,9 +34,14 @@ GLOBAL, SEQ = 96, 128
 def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None):
     import os as _os
     _attn = {"attention_impl": _os.environ["ATTN"]} if _os.environ.get("ATTN") else {}
+    if _os.environ.get("MATMUL"):
+        _attn["matmul_impl"] = _os.environ["MATMUL"]
     global_batch = global_batch or GLOBAL
     seq = seq or SEQ
     mesh = build_mesh()
+    from pytorch_distributed_training_tpu.ops.dispatch import set_kernel_mesh
+
+    set_kernel_mesh(mesh)  # multi-chip: keep the Pallas kernel path active
     mcfg = model_preset(model_name, dropout_impl="kernel", **_attn)
     if mcfg.causal:
         from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel

@@ -242,3 +242,53 @@ def test_real_data_path_end_to_end_with_fixture_vocab(
     )
     history = trainer.run()
     assert len(history) == 1 and np.isfinite(history[-1]["train_loss"])
+
+
+def test_single_sentence_encode():
+    # SST-2-style single-sentence rows: texts_b=None -> [CLS] a [SEP], all
+    # token types 0, exactly one [SEP]
+    tok = HashTokenizer(vocab_size=1000)
+    out = encode_pairs(tok, ["The movie was great.", "terrible"], None,
+                       max_length=16)
+    for i in range(2):
+        row = out["input_ids"][i]
+        live = out["attention_mask"][i] == 1
+        assert row[0] == CLS_ID
+        assert (row[live] == SEP_ID).sum() == 1
+        assert (out["token_type_ids"][i] == 0).all()
+
+
+def test_eval_splits_table():
+    from pytorch_distributed_training_tpu.data.glue import eval_splits
+
+    assert eval_splits("mrpc") == [("", "validation")]
+    assert eval_splits("sst2") == [("", "validation")]
+    assert eval_splits("mnli") == [
+        ("matched", "validation"),
+        ("mismatched", "validation_mismatched"),
+    ]
+
+
+def test_new_task_rows_offline_fallback():
+    # zero-egress image: every hub task falls back to the synthetic pair
+    # task, preserving the task's num_labels
+    for task, n_labels in [("sst2", 2), ("qnli", 2), ("mnli", 3)]:
+        data, num_labels = load_task_arrays(
+            task, "validation", max_length=32, synthetic_sizes=(64, 32)
+        )
+        assert num_labels == n_labels
+        assert data["input_ids"].shape == (32, 32)
+        assert int(data["labels"].max()) <= n_labels - 1
+    # mismatched is a DIFFERENT sample than matched
+    matched, _ = load_task_arrays(
+        "mnli", "validation", max_length=32, synthetic_sizes=(64, 32)
+    )
+    mismatched, _ = load_task_arrays(
+        "mnli", "validation_mismatched", max_length=32, synthetic_sizes=(64, 32)
+    )
+    assert not np.array_equal(matched["input_ids"], mismatched["input_ids"])
+
+
+def test_mismatched_split_rejected_for_non_mnli():
+    with pytest.raises(ValueError, match="mismatched"):
+        load_task_arrays("mrpc", "validation_mismatched", max_length=32)

@@ -200,3 +200,102 @@ def test_bert_scan_relayout_matches_forward():
     assert jax.tree.all(
         jax.tree.map(lambda a, b: jnp.array_equal(a, b), sp, restacked)
     )
+
+
+def test_remat_policies_preserve_gradients(eight_devices):
+    """remat=True with each remat_policy computes the same loss and grads
+    as the unrematted layer (selective remat only changes WHAT is saved,
+    never the math). VERDICT r2 #5's selective-remat knob."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.models import (
+        BertForSequenceClassification,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    def grads_for(**kw):
+        cfg = model_preset(
+            "tiny", compute_dtype="float32", hidden_dropout=0.0,
+            attention_dropout=0.0, **kw
+        )
+        model = BertForSequenceClassification(cfg)
+        batch = {
+            "input_ids": jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % 50,
+            "attention_mask": jnp.ones((2, 16), jnp.int32),
+            "token_type_ids": jnp.zeros((2, 16), jnp.int32),
+        }
+        params = model.init(jax.random.key(0), **batch, deterministic=True)
+
+        def loss(p):
+            logits = model.apply(p, **batch, deterministic=True)
+            return jnp.mean(logits ** 2)
+
+        return jax.grad(loss)(params)
+
+    base = grads_for()
+    for policy in ("nothing", "dots", "weight_dots"):
+        got = grads_for(remat=True, remat_policy=policy)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-6, atol=1e-6
+            ),
+            base, got,
+        )
+    with pytest.raises(ValueError, match="remat_policy"):
+        grads_for(remat=True, remat_policy="bogus")
+
+
+def test_int8_matmul_impl_parity_and_layout(eight_devices):
+    """matmul_impl="int8" (ops/quant.py) keeps the exact parameter tree of
+    the native path (checkpoint/HF-loader compatible) and computes logits
+    close to bf16 (dynamic int8 quantization error only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.models import (
+        BertForSequenceClassification,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    batch = {
+        "input_ids": jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % 50,
+        "attention_mask": jnp.ones((2, 16), jnp.int32),
+        "token_type_ids": jnp.zeros((2, 16), jnp.int32),
+    }
+
+    def build(impl):
+        cfg = model_preset(
+            "tiny", hidden_dropout=0.0, attention_dropout=0.0,
+            matmul_impl=impl,
+        )
+        model = BertForSequenceClassification(cfg)
+        params = model.init(jax.random.key(0), **batch, deterministic=True)
+        return model, params
+
+    native, p_native = build("native")
+    quant, p_quant = build("int8")
+    # identical parameter trees (same names, shapes, dtypes)
+    assert jax.tree.structure(p_native) == jax.tree.structure(p_quant)
+    for a, b in zip(jax.tree.leaves(p_native), jax.tree.leaves(p_quant)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    # int8 logits track the native ones through the SAME params
+    logits_native = native.apply(p_native, **batch, deterministic=True)
+    logits_quant = quant.apply(p_native, **batch, deterministic=True)
+    diff = np.abs(
+        np.asarray(logits_native, np.float32) - np.asarray(logits_quant, np.float32)
+    ).max()
+    scale = np.abs(np.asarray(logits_native, np.float32)).max()
+    assert diff < 0.15 * max(scale, 1.0)
+    # gradients flow (STE) in both int8 modes
+    for impl in ("int8", "int8_full"):
+        m, _ = build(impl)
+
+        def loss(p):
+            return jnp.mean(m.apply(p, **batch, deterministic=True) ** 2)
+
+        g = jax.grad(loss)(p_native)
+        assert all(
+            np.isfinite(np.asarray(x, np.float32)).all()
+            for x in jax.tree.leaves(g)
+        )

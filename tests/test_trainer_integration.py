@@ -159,3 +159,79 @@ def test_checkpoint_restore_across_prng_impl(mini_trained, tmp_path):
         jax.random.key_data(restored.dropout_rng).shape
         == jax.random.key_data(fresh.state.dropout_rng).shape
     )
+
+
+def test_mnli_evaluates_both_validation_splits(eight_devices):
+    """MNLI's standard eval covers matched AND mismatched validation
+    (VERDICT r2 #7). Offline this exercises the synthetic fallback with
+    3 labels and two distinct eval splits; metric keys carry both the
+    unprefixed (primary) and per-split names."""
+    mcfg = model_preset("tiny", compute_dtype="float32")
+    tcfg = TrainConfig(
+        num_epochs=1, global_batch_size=32, micro_batch_size=16,
+        eval_batch_size=32, log_every=0, bf16=False,
+        train_size=64, eval_size=32,
+    )
+    trainer = Trainer(
+        mcfg, tcfg, MeshConfig(data=4, fsdp=2),
+        ShardingPolicy(fsdp=True, fsdp_min_size=128),
+        task="mnli",
+    )
+    assert trainer.mcfg.num_labels == 3
+    assert set(trainer.eval_loaders) == {"matched", "mismatched"}
+    history = trainer.run()
+    rec = history[-1]
+    assert {"accuracy", "accuracy_matched", "accuracy_mismatched"} <= set(rec)
+    assert rec["accuracy"] == rec["accuracy_matched"]
+    assert 0.0 <= rec["accuracy_mismatched"] <= 1.0
+
+
+def test_checkpoint_restore_across_topologies(mini_trained, tmp_path):
+    """VERDICT r2 #8: a checkpoint written under one mesh/policy restores
+    under a different one. ``mini_trained`` saves from a data=4 x fsdp=2
+    param-sharded state; a pure-DP (data=8, replicated params) trainer must
+    restore it bit-exactly, re-place every leaf on ITS shardings, and
+    continue training — the "resume on any compatible mesh" contract in
+    train/checkpoint.py's docstring."""
+    import jax
+
+    from pytorch_distributed_training_tpu.parallel import state_shardings
+    from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+
+    trainer, _ = mini_trained
+    d = str(tmp_path / "ckpt_topo")
+    ckpt.save_checkpoint(d, trainer.state)
+
+    mcfg = model_preset("tiny", compute_dtype="float32")
+    tcfg = TrainConfig(
+        num_epochs=1, global_batch_size=32, micro_batch_size=16,
+        eval_batch_size=32, log_every=0, bf16=False,
+        train_size=128, eval_size=32,
+    )
+    dp = Trainer(
+        mcfg, tcfg, MeshConfig(data=8), ShardingPolicy(), task="synthetic"
+    )
+    assert dp.mesh.shape != trainer.mesh.shape  # genuinely different meshes
+    restored = ckpt.restore_checkpoint(d, dp.state)
+
+    # bit-exact params across the topology change
+    a = np.concatenate(
+        [np.ravel(jax.device_get(x))
+         for x in jax.tree.leaves(trainer.state.params)]
+    )
+    b = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(restored.params)]
+    )
+    np.testing.assert_array_equal(a, b)
+    # every leaf landed on the DP trainer's shardings (replicated params)
+    for want, got in zip(
+        jax.tree.leaves(dp.shardings.params), jax.tree.leaves(restored.params)
+    ):
+        assert got.sharding.is_equivalent_to(want, got.ndim)
+    # and training continues from the restored state on the new mesh
+    dp.state = restored
+    step_before = int(jax.device_get(dp.state.step))
+    batch = next(iter(dp.train_loader.epoch(0)))
+    dp.state, metrics = dp.train_step(dp.state, batch)
+    assert int(jax.device_get(dp.state.step)) == step_before + 1
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
