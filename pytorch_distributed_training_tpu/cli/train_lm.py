@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="jax.checkpoint each block (bigger micro batches)")
+    p.add_argument("--matmul-impl", default="native",
+                   choices=("native", "int8", "int8_full"),
+                   help="dense-matmul path (ops/quant.py): int8 runs the "
+                        "MXU's 2x-rate int8 tier with dynamic quantization")
     p.add_argument("--remat-policy", default="nothing",
                    choices=("nothing", "dots", "weight_dots"),
                    help="what remat saves: nothing = full recompute; dots = "
@@ -65,6 +69,7 @@ def main(argv=None) -> list[dict]:
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         scan_layers=args.scan_layers,
         remat=args.remat, remat_policy=args.remat_policy,
+        matmul_impl=args.matmul_impl,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     if not mcfg.causal:

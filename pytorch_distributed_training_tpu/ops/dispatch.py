@@ -198,3 +198,41 @@ def axes_size(mesh: Mesh, axes: Sequence[str]) -> int:
     for a in axes:
         n *= mesh.shape.get(a, 1)
     return n
+
+
+def plan_shards(shape: Sequence[int], extra_axes: dict):
+    """Common shard-plan core for every kernel's shard_map wrapper.
+
+    Dim 0 always shards over the registered batch axes; each
+    ``{dim: mesh_axis}`` in ``extra_axes`` additionally shards that dim
+    when the axis is >1 in the mesh. Returns
+    ``(mesh, PartitionSpec, axes_used, local_shape)`` — ``axes_used`` is
+    the ordered axis list for :func:`linear_device_index` seed offsets,
+    ``local_shape`` the per-shard shape for the caller's own tileability
+    checks — or None when no mesh is registered or a sharded dim doesn't
+    divide (caller falls back to its XLA math). ONE implementation so the
+    axis convention and divisibility rule can't drift between the ops
+    (layer_norm row kernels, mask-scale, flash)."""
+    from jax.sharding import PartitionSpec as P
+
+    ctx = kernel_ctx()
+    if ctx is None:
+        return None
+    mesh, batch_axes, _, _ = ctx
+    entries: list = [None] * len(shape)
+    entries[0] = tuple(batch_axes)
+    axes_used = list(batch_axes)
+    local = list(shape)
+    f0 = axes_size(mesh, batch_axes)
+    if shape[0] % f0:
+        return None
+    local[0] //= f0
+    for dim, axis_name in extra_axes.items():
+        f = mesh.shape.get(axis_name, 1)
+        if f > 1:
+            if shape[dim] % f:
+                return None
+            entries[dim] = axis_name
+            axes_used.append(axis_name)
+            local[dim] //= f
+    return mesh, P(*entries), axes_used, tuple(local)

@@ -148,7 +148,7 @@ def _mask_scale_sharded(x, rate: float, rng):
     probs [B, N, S, S] under tensor parallelism) or the seq axis for 3-D
     activations. Returns None when the registered mesh doesn't divide the
     shape (caller falls back to the jax-stream mask)."""
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import PartitionSpec as P  # noqa: F401 (body spec)
 
     from pytorch_distributed_training_tpu.ops import dispatch
     from pytorch_distributed_training_tpu.ops.dispatch import shard_map
@@ -156,25 +156,14 @@ def _mask_scale_sharded(x, rate: float, rng):
     ctx = dispatch.kernel_ctx()
     if ctx is None or x.ndim < 2:
         return None
-    mesh, batch_axes, seq_axis, head_axis = ctx
-    entries = [tuple(batch_axes)]
-    axes_used = list(batch_axes)
-    f0 = dispatch.axes_size(mesh, batch_axes)
-    if x.shape[0] % f0:
-        return None
+    _, _, seq_axis, head_axis = ctx
     dim1_axis = head_axis if x.ndim == 4 else seq_axis
-    f1 = mesh.shape.get(dim1_axis, 1) if x.ndim >= 3 else 1
-    if x.ndim >= 3:
-        if x.shape[1] % f1:
-            return None
-        entries.append(dim1_axis if f1 > 1 else None)
-        if f1 > 1:
-            axes_used.append(dim1_axis)
-    entries += [None] * (x.ndim - len(entries))
-    local_shape = list(x.shape)
-    local_shape[0] //= f0
-    if x.ndim >= 3:
-        local_shape[1] //= f1
+    plan = dispatch.plan_shards(
+        x.shape, {1: dim1_axis} if x.ndim >= 3 else {}
+    )
+    if plan is None:
+        return None
+    mesh, spec, axes_used, local_shape = plan
     # decide tileability on the LOCAL shard shape, outside the body
     n = 1
     for d in local_shape:
@@ -182,7 +171,6 @@ def _mask_scale_sharded(x, rate: float, rng):
     if (n // 128) * 128 != n or pow2_row_block(n // 128, 512) < 16:
         return None
     seed = derive_kernel_seed(rng)
-    spec = P(*entries)
 
     def body(xl, seedl):
         with dispatch.manual_region():

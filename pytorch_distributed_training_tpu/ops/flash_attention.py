@@ -860,9 +860,10 @@ def flash_attention(
 
 def _flash_shard_plan(q):
     """shard_map plan for [B, S, N, D] attention inputs: batch axes on
-    dim 0, the head axis (tensor parallelism) on dim 2. None when the
-    registered mesh doesn't divide the shape, or when a seq axis is active
-    (context parallelism routes through ops/ring_attention instead)."""
+    dim 0, the head axis (tensor parallelism) on dim 2
+    (dispatch.plan_shards). None when the registered mesh doesn't divide
+    the shape, or when a seq axis is active (context parallelism routes
+    through ops/ring_attention instead)."""
     from jax.sharding import PartitionSpec as P
 
     from pytorch_distributed_training_tpu.ops import dispatch
@@ -873,11 +874,9 @@ def _flash_shard_plan(q):
     mesh, batch_axes, seq_axis, head_axis = ctx
     if mesh.shape.get(seq_axis, 1) > 1:
         return None
-    f0 = dispatch.axes_size(mesh, batch_axes)
-    fh = mesh.shape.get(head_axis, 1)
-    if q.shape[0] % f0 or q.shape[2] % fh:
+    plan = dispatch.plan_shards(q.shape, {2: head_axis})
+    if plan is None:
         return None
-    axes_used = list(batch_axes) + ([head_axis] if fh > 1 else [])
-    spec = P(tuple(batch_axes), None, head_axis if fh > 1 else None, None)
+    mesh, spec, axes_used, _ = plan
     bias_spec = P(tuple(batch_axes), None, None, None)
     return mesh, spec, bias_spec, axes_used

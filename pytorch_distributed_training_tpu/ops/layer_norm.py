@@ -194,39 +194,29 @@ from pytorch_distributed_training_tpu.ops.dispatch import (
 
 
 def _row_shard_plan(x, block_r: int):
-    """shard_map plan for a row-wise kernel on ``x`` [..., H]: PartitionSpec
-    (batch axes on dim 0, the seq axis on dim 1 when present), the axis
-    names used (for seed offsetting), and the LOCAL row-block size — or
-    None when the shape doesn't divide over the registered mesh (caller
-    falls back to the XLA math)."""
-    from jax.sharding import PartitionSpec as P
-
+    """shard_map plan for a row-wise kernel on ``x`` [..., H]: batch axes
+    on dim 0, the seq axis on dim 1 when present (dispatch.plan_shards),
+    plus the LOCAL row-block size — or None when the shape doesn't divide
+    over the registered mesh (caller falls back to the XLA math)."""
     from pytorch_distributed_training_tpu.ops import dispatch
 
     ctx = dispatch.kernel_ctx()
     if ctx is None:
         return None
-    mesh, batch_axes, seq_axis, _ = ctx
-    f0 = dispatch.axes_size(mesh, batch_axes)
-    entries = [tuple(batch_axes)]
-    axes_used = list(batch_axes)
-    f1 = 1
-    if x.ndim >= 3:
-        f1 = mesh.shape.get(seq_axis, 1)
-        entries.append(seq_axis if f1 > 1 else None)
-        if f1 > 1:
-            axes_used.append(seq_axis)
-    entries += [None] * (x.ndim - len(entries))
-    if x.shape[0] % f0 or (x.ndim >= 3 and x.shape[1] % f1):
+    seq_axis = ctx[2]
+    plan = dispatch.plan_shards(
+        x.shape, {1: seq_axis} if x.ndim >= 3 else {}
+    )
+    if plan is None:
         return None
+    mesh, spec, axes_used, local_shape = plan
     rows_local = 1
-    for d in x.shape[:-1]:
+    for d in local_shape[:-1]:
         rows_local *= d
-    rows_local //= f0 * f1
     br = pow2_row_block(rows_local, block_r)
     if br < 16:
         return None
-    return mesh, P(*entries), axes_used, br
+    return mesh, spec, axes_used, br
 
 
 def layer_norm(
