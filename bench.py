@@ -55,6 +55,7 @@ def run_bench(
     repeats: int = 3,
     chain_steps: int = 1,
     matmul_impl: str = "default",
+    quant_delayed: bool = False,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -99,6 +100,15 @@ def run_bench(
             "int8_full" if model_name == "bert-large-cased" else "native"
         )
     mcfg.matmul_impl = matmul_impl
+    if quant_delayed:
+        if matmul_impl not in ("int8", "int8_full"):
+            raise SystemExit(
+                "--quant-delayed requires an int8 matmul impl "
+                f"(got {matmul_impl!r})"
+            )
+        # delayed activation scaling (ops/quant.py): amaxes carried in the
+        # train state, calibrated below on the first batch
+        mcfg.quant_delayed = True
     need_pos = (
         seq_len + mcfg.pad_token_id + 1 if mcfg.roberta_style else seq_len
     )
@@ -225,6 +235,13 @@ def run_bench(
         calls_per_pass = timed_steps
         warmup_calls = warmup_steps
 
+    if state.quant is not None:
+        from pytorch_distributed_training_tpu.train.step import calibrate_quant
+
+        state = calibrate_quant(
+            state, jax.tree.map(lambda x: x[0], place(0))
+        )
+
     for i in range(warmup_calls):
         state, metrics = train_step(state, feed(i))
     jax.block_until_ready(state.params)
@@ -256,6 +273,7 @@ def run_bench(
         "grad_accum_steps": tcfg.grad_accum_steps,
         "final_loss": float(jax.device_get(metrics["loss"])),
         "matmul_impl": mcfg.matmul_impl,
+        "quant_delayed": mcfg.quant_delayed,
     }
     if chain_steps > 1:
         extra["chain_steps"] = chain_steps
@@ -284,6 +302,10 @@ def main(argv=None):
                         "int8_full for the convergence-gated bert-large "
                         "recipe, native elsewhere; picking int8 explicitly "
                         "for an ungated recipe is on the caller")
+    p.add_argument("--quant-delayed", action="store_true",
+                   help="delayed (previous-microbatch) int8 activation "
+                        "scaling — removes the per-site absmax "
+                        "serialization (ops/quant.py)")
     args = p.parse_args(argv)
     result = run_bench(
         model_name=args.model,
@@ -294,6 +316,7 @@ def main(argv=None):
         timed_steps=args.timed_steps,
         chain_steps=args.chain_steps,
         matmul_impl=args.matmul_impl,
+        quant_delayed=args.quant_delayed,
     )
     print(json.dumps(result))
     return result

@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("native", "int8", "int8_full"),
                    help="dense-matmul path (ops/quant.py): int8 runs the "
                         "MXU's 2x-rate int8 tier with dynamic quantization")
+    p.add_argument("--quant-delayed", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="delayed (previous-microbatch) int8 activation "
+                        "scaling: amaxes carried in the train state, "
+                        "calibrated on the first batch (ops/quant.py)")
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction,
                    default=False, help="shard params/opt state over fsdp axis")
     p.add_argument("--mesh-data", type=int, default=-1)
@@ -70,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
+    if args.quant_delayed and args.matmul_impl == "native":
+        # silent no-op otherwise: dense_general only reads quant_delayed on
+        # the int8 path, and a mislabeled A/B artifact is worse than an error
+        raise SystemExit(
+            "--quant-delayed requires --matmul-impl int8|int8_full"
+        )
     tcfg = dataclass_from_args(TrainConfig, args)
     # bf16 flag maps onto the model dtype policy
     from pytorch_distributed_training_tpu.cli import resolve_attention
@@ -78,6 +89,7 @@ def main(argv=None) -> list[dict]:
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         matmul_impl=args.matmul_impl,
+        quant_delayed=args.quant_delayed,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(

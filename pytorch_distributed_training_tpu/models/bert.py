@@ -105,6 +105,7 @@ def dense_general(cfg: ModelConfig, features, axis, name, kw):
     return QuantDenseGeneral(
         features=feats, axis=ax,
         mode="full" if cfg.matmul_impl == "int8_full" else "fwd",
+        delayed=cfg.quant_delayed,
         dtype=kw["dtype"], param_dtype=kw["param_dtype"],
         kernel_init=kw["kernel_init"], name=name,
     )
@@ -274,15 +275,12 @@ def remat_policy(cfg: ModelConfig):
         return None
     import jax
 
-    policies = {
+    # name validity is enforced once, in ModelConfig.__post_init__ — a
+    # KeyError here means a config bypassed the dataclass constructor
+    return {
         "dots": jax.checkpoint_policies.dots_saveable,
         "weight_dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-    }
-    if name not in policies:
-        raise ValueError(
-            f"remat_policy must be nothing/dots/weight_dots, got {name!r}"
-        )
-    return policies[name]
+    }[name]
 
 
 def _layer_cls(cfg: ModelConfig):
@@ -387,7 +385,9 @@ class BertEncoderModel(nn.Module):
             # transfer was a hand-written ``.to(second_device)`` at :62-63).
             scan = nn.scan(
                 _ScanBlock,
-                variable_axes={"params": 0},
+                # "quant": per-layer delayed-int8 amaxes stack on the same
+                # leading [num_layers] dim as the params (no-op otherwise)
+                variable_axes={"params": 0, "quant": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,),
                 length=cfg.num_layers,

@@ -87,7 +87,8 @@ class BranchEnsembleClassifier(nn.Module):
         # inputs broadcast, outputs stack on axis 0.
         branches = nn.vmap(
             _EncoderStack,
-            variable_axes={"params": 0},
+            # "quant": per-branch delayed-int8 amaxes (ops/quant.py)
+            variable_axes={"params": 0, "quant": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(None, None, None),
             out_axes=0,

@@ -150,7 +150,8 @@ class GPT2LMModel(nn.Module):
         if cfg.scan_layers:
             scan = nn.scan(
                 _GPT2ScanBlock,
-                variable_axes={"params": 0},
+                # "quant": per-layer delayed-int8 amaxes (ops/quant.py)
+                variable_axes={"params": 0, "quant": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,),
                 length=cfg.num_layers,

@@ -23,10 +23,24 @@ dequantized) operands — the true gradient of the quantized forward, modulo
 the STE step. ``QuantMode`` picks how the backward matmuls themselves
 execute:
 
-- ``"fwd"``  — backward in bf16 (dgrad/wgrad full precision). ~⅓ of the
-  dot FLOPs go 2×; the gradient path keeps full mantissa.
+- ``"fwd"``  — backward runs bf16-input dots with f32 accumulation (the
+  saved int8 operands are dequantized to the compute dtype first). ~⅓ of
+  the dot FLOPs go 2×; the gradient dots keep the bf16 mantissa.
 - ``"full"`` — dgrad and wgrad also int8, with fresh dynamic per-tensor
   scales for ``dy``. Fastest; gradient quantization noise is the price.
+
+Dynamic per-tensor activation scales cost one absmax reduce-to-scalar pass
+over every dense input per microbatch — a full HBM re-read that must
+COMPLETE before the quantize pass can start (~9 ms/step on the bert-large
+recipe, NOTES.md). ``delayed=True`` on :class:`QuantDenseGeneral` breaks
+that serialization FP8-recipe style: each site quantizes with the amax
+observed on the PREVIOUS microbatch (carried in the flax ``"quant"``
+variable collection, threaded through the train step's scan carry and the
+TrainState), while the CURRENT amax is computed concurrently with the
+quantized dot for the next iteration. Values that outgrow the stale scale
+saturate at ±127 for one microbatch — the same clipping semantics as any
+int8 quantizer, one step late. Step 0 needs calibrated scales
+(``train.step.calibrate_quant`` runs one forward on the first real batch).
 
 This is an OPT-IN config (``ModelConfig.matmul_impl="int8"``), never a
 silent default: convergence must be demonstrated per-recipe (see
@@ -53,11 +67,19 @@ def _absmax(x, axes, keepdims=True):
     return jnp.maximum(m, 1e-12)
 
 
+def _quantize(x, scale):
+    """THE quantization grid (symmetric, saturating at ±127) — every int8
+    cast in this module goes through here so the dynamic, delayed, and
+    per-channel paths cannot silently diverge."""
+    return jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+
+
 def quantize_per_tensor(x):
     """→ (int8 tensor, fp32 scalar scale). x ≈ q * scale."""
     scale = _absmax(x, axes=None, keepdims=False) / _INT8_MAX
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
-    return q.astype(jnp.int8), scale
+    return _quantize(x, scale), scale
 
 
 def quantize_per_channel(w, contract_axis):
@@ -65,8 +87,7 @@ def quantize_per_channel(w, contract_axis):
     the matmul result). ``contract_axis`` is the axis being contracted away
     (reduced over when taking absmax)."""
     scale = _absmax(w, axes=contract_axis) / _INT8_MAX
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return q.astype(jnp.int8), jnp.squeeze(scale, axis=contract_axis)
+    return _quantize(w, scale), jnp.squeeze(scale, axis=contract_axis)
 
 
 def _fwd_dims(x_ndim: int, n_contract: int):
@@ -79,15 +100,21 @@ def _fwd_dims(x_ndim: int, n_contract: int):
     )
 
 
-def _quantized_dot(x, kernel, n_contract):
+def _quantized_dot(x, kernel, n_contract, x_scale=None):
     """Shared quantize → int8 dot → rescale body, on NATIVE shapes — no
     2-D reshape: an explicit reshape of an int8 (32,128)-tiled array is a
     materialized relayout copy on TPU (measured ~7 ms/step of pure copies
     on the bert-large recipe before this was dims-based). Returns the
     result in ``x``'s dtype plus the quantized operands/scales (the
     custom-VJP residuals; the primal drops them). ONE implementation so
-    the primal and the VJP forward cannot diverge."""
-    xq, sx = quantize_per_tensor(x)
+    the primal and the VJP forward cannot diverge — the delayed path
+    differs ONLY in passing a carried ``x_scale`` instead of computing a
+    fresh per-tensor one."""
+    if x_scale is None:
+        xq, sx = quantize_per_tensor(x)
+    else:
+        sx = x_scale
+        xq = _quantize(x, sx)
     wq, sw = quantize_per_channel(
         kernel, contract_axis=tuple(range(n_contract))
     )  # sw: [f1..fm]
@@ -169,6 +196,52 @@ def _int8_dense_bwd(n_contract, mode, res, dy):
 int8_dense.defvjp(_int8_dense_fwd, _int8_dense_bwd)
 
 
+# ------------------------------------------------------- delayed scaling
+def _delayed_quantized_dot(x, kernel, amax_prev, n_contract):
+    """``_quantized_dot`` with a STALE (carried) activation scale.
+
+    There is no data dependency between the quantize pass and any reduce
+    over ``x``: ``scale`` is a carried scalar, so XLA can fuse the quantize
+    into ``x``'s producer (the gelu epilogue, the LN output) and overlap
+    the fresh-amax reduce with the dot. Returns (y, new_amax, residuals)."""
+    scale = jnp.maximum(amax_prev, 1e-12) / _INT8_MAX
+    new_amax = _absmax(x, axes=None, keepdims=False)
+    y, res = _quantized_dot(x, kernel, n_contract, x_scale=scale)
+    return y, new_amax, res
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def int8_dense_delayed(x, kernel, amax_prev, n_contract: int = 1,
+                       mode: str = "full"):
+    """:func:`int8_dense` with delayed (previous-step) activation scaling.
+
+    → ``(y, new_amax)``. ``amax_prev`` is the carried fp32 scalar amax of
+    this site's input from the previous microbatch; ``new_amax`` is the
+    current input's amax, to be carried forward. The backward is identical
+    to :func:`int8_dense`'s (the saved residuals record the scale actually
+    used); ``amax_prev`` gets a zero cotangent (scales are constants under
+    the STE, exactly as the dynamic path treats its fresh scales).
+    """
+    return _delayed_quantized_dot(x, kernel, amax_prev, n_contract)[:2]
+
+
+def _int8_dense_delayed_fwd(x, kernel, amax_prev, n_contract, mode):
+    y, new_amax, (xq, scale, wq, sw) = _delayed_quantized_dot(
+        x, kernel, amax_prev, n_contract
+    )
+    sent = (jnp.zeros((0,), x.dtype), jnp.zeros((0,), kernel.dtype))
+    return (y, new_amax), (xq, scale, wq, sw, sent)
+
+
+def _int8_dense_delayed_bwd(n_contract, mode, res, cts):
+    dy, _d_amax = cts  # new_amax is an observation, not a differentiable path
+    dx, dw = _int8_dense_bwd(n_contract, mode, res, dy)
+    return dx, dw, jnp.zeros((), jnp.float32)
+
+
+int8_dense_delayed.defvjp(_int8_dense_delayed_fwd, _int8_dense_delayed_bwd)
+
+
 def int8_matmul(x2d, w2d, mode: str = "fwd"):
     """2-D convenience wrapper over :func:`int8_dense` ([T,K]·[K,N])."""
     return int8_dense(x2d, w2d, 1, mode)
@@ -202,11 +275,18 @@ class QuantDenseGeneral(nn.Module):
     checkpoints and the HF weight loader are layout-agnostic: a model can
     be trained int8 and evaluated bf16 or vice versa by flipping
     ``ModelConfig.matmul_impl`` alone.
+
+    ``delayed=True`` switches the activation scale to delayed (previous
+    microbatch) amax carried in the ``"quant"`` variable collection — see
+    the module docstring. Callers must apply with ``mutable=["quant"]``
+    during training (the train step threads the collection through its
+    accumulation scan) and calibrate once before step 0.
     """
 
     features: tuple  # output feature dims (tuple, possibly length 1)
     axis: tuple = (-1,)  # contracted input axes
     mode: str = "fwd"  # int8_matmul mode: "fwd" | "full"
+    delayed: bool = False  # delayed activation scaling via "quant" collection
     use_bias: bool = True
     dtype: object = jnp.bfloat16
     param_dtype: object = jnp.float32
@@ -230,6 +310,23 @@ class QuantDenseGeneral(nn.Module):
             if self.use_bias
             else None
         )
+        if self.delayed:
+            amax = self.variable(
+                "quant", "amax", lambda: jnp.zeros((), jnp.float32)
+            )
+            y, new_amax = int8_dense_delayed(
+                x, kernel, amax.value, len(axis), self.mode
+            )
+            # init + every mutable apply observe the current amax; an
+            # immutable apply (a caller that forgot mutable=["quant"]) keeps
+            # the stale value rather than erroring — eval reuses training's
+            # last scales that way.
+            if self.is_mutable_collection("quant"):
+                amax.value = new_amax
+            y = y.astype(self.dtype)
+            if bias is not None:
+                y = y + bias.astype(self.dtype)
+            return y
         return quant_dense_apply(
             x, kernel, bias, n_contract=len(axis), mode=self.mode,
             out_dtype=self.dtype,

@@ -312,6 +312,14 @@ class GPipeClassifier:
                              "(the stage axis shards the stacked layer dim)")
         if config.causal:
             raise ValueError("GPipeClassifier is an encoder-classifier trunk")
+        if getattr(config, "quant_delayed", False):
+            # the pipeline trunk applies layers as raw functions — there is
+            # no flax "quant" collection to carry amaxes through; dynamic
+            # int8 (stateless) works, delayed scaling does not
+            raise ValueError(
+                "quant_delayed is unsupported under the GPipe pipeline; "
+                "use dynamic int8 (matmul_impl alone) or the serial trunk"
+            )
         self.config = config
         self.mesh = mesh
         self.n_micro = int(n_micro)

@@ -37,11 +37,41 @@ def _saveable(state: TrainState) -> dict:
     import jax.numpy as jnp
 
     d = {k: getattr(state, k) for k in _SAVEABLE}
+    if state.quant is not None:
+        # delayed-int8 amaxes: step N quantizes with step N-1's scales, so
+        # bitwise-exact resume requires restoring them (both sides build
+        # their abstract tree from the same state, so save/restore agree on
+        # whether the key exists)
+        d["quant"] = state.quant
     words = jax.random.key_data(state.dropout_rng).ravel().astype(jnp.uint32)
     buf = jnp.zeros((_RNG_BUF_WORDS + 1,), jnp.uint32)
     buf = buf.at[0].set(words.size).at[1 : 1 + words.size].set(words)
     d["dropout_rng"] = buf
     return d
+
+
+def _restore_standard(mngr, step: int, state: TrainState) -> dict:
+    """StandardRestore into ``state``'s abstract tree, with a clear message
+    for the one structural mismatch a user can cause: the ``quant`` subtree
+    exists iff the run used quant_delayed, so saving and resuming runs must
+    agree on the flag (orbax's raw tree-mismatch error doesn't say that)."""
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _saveable(state))
+    try:
+        return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+    except Exception as e:
+        # relabel ONLY the structure mismatch this flag can cause (the
+        # orbax error names the offending subtree); anything else — torn
+        # writes, dtype/sharding mismatches — propagates untouched
+        if "quant" not in str(e):
+            raise
+        on = state.quant is not None
+        raise ValueError(
+            f"checkpoint restore failed (step {step}) on the 'quant' "
+            f"subtree: this run has quant_delayed {'ON' if on else 'OFF'}, "
+            f"and checkpoints carry the delayed-int8 amaxes only when the "
+            f"saving run had it ON — save and resume must agree on "
+            f"--quant-delayed"
+        ) from e
 
 
 def _merge_restored(state: TrainState, restored: dict) -> TrainState:
@@ -102,10 +132,7 @@ class Checkpointer:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(
-            ocp.utils.to_shape_dtype_struct, _saveable(state)
-        )
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        restored = _restore_standard(self._mngr, step, state)
         log0(f"checkpoint restored: {self.directory}/{step}")
         return _merge_restored(state, dict(restored))
 
@@ -223,9 +250,6 @@ def restore_checkpoint(
         step = mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        abstract = jax.tree.map(
-            ocp.utils.to_shape_dtype_struct, _saveable(state)
-        )
-        restored = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        restored = _restore_standard(mngr, step, state)
     log0(f"checkpoint restored: {directory}/{step}")
     return _merge_restored(state, dict(restored))

@@ -188,9 +188,40 @@ class Trainer:
             if train_config.checkpoint_dir
             else None
         )
+        restored = False
         if train_config.resume and self.checkpointer:
             if self.checkpointer.latest_step() is not None:
                 self.state = self.checkpointer.restore(self.state)
+                restored = True
+        if self.state.quant is not None and not restored:
+            # delayed int8 scaling: observe step-0 amaxes on one microbatch
+            # of real rows (a restored run already carries its scales — no
+            # point compiling a forward just to overwrite it). Built straight
+            # from the dataset arrays — NOT by peeking the train loader:
+            # abandoning a native-loader generator mid-epoch leaks its
+            # prefetch slot and races the calibration batch's async H2D
+            # against the next epoch's slot reuse.
+            from pytorch_distributed_training_tpu.comms.ingest import (
+                make_global_batch,
+            )
+            from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES
+            from pytorch_distributed_training_tpu.train.step import (
+                calibrate_quant,
+            )
+            from jax.sharding import PartitionSpec as P
+
+            micro = (
+                train_config.global_batch_size
+                // train_config.grad_accum_steps
+            )
+            rows = {
+                k: np.asarray(v)[
+                    np.arange(micro) % len(v)  # wrap tiny datasets
+                ]
+                for k, v in train_data.items()
+            }
+            micro0 = make_global_batch(self.mesh, rows, pspec=P(BATCH_AXES))
+            self.state = calibrate_quant(self.state, micro0)
 
         self.train_step = make_train_step(
             grad_accum_steps=train_config.grad_accum_steps,

@@ -26,6 +26,12 @@ class TrainState:
     dropout_rng: jax.Array
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # Non-parameter model state mutated by the forward pass: today the
+    # "quant" collection of delayed int8 activation amaxes (ops/quant.py).
+    # None for models without such state (None is an empty pytree, so every
+    # existing step/sharding/checkpoint path is unchanged); otherwise the
+    # step threads it through its accumulation scan and writes it back.
+    quant: Any = None
 
     def apply_gradients(self, grads) -> "TrainState":
         updates, new_opt_state = self.tx.update(
@@ -49,15 +55,15 @@ def create_train_state(
     init_rng, dropout_rng = jax.random.split(rng)
 
     def _init(r, batch):
-        variables = model.init(
+        return model.init(
             r,
             batch["input_ids"],
             batch.get("attention_mask"),
             batch.get("token_type_ids"),
         )
-        return variables["params"]
 
-    params = jax.jit(_init)(init_rng, example_batch)
+    variables = jax.jit(_init)(init_rng, example_batch)
+    params = variables["params"]
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -65,4 +71,7 @@ def create_train_state(
         dropout_rng=dropout_rng,
         apply_fn=model.apply,
         tx=tx,
+        # delayed-quant amaxes observed on the init dummy batch; real
+        # calibration (train.step.calibrate_quant) overwrites before step 0
+        quant=variables.get("quant"),
     )

@@ -40,21 +40,55 @@ from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES, TRAIN_BATCH_
 from pytorch_distributed_training_tpu.train.state import TrainState
 
 
-def _apply(state: TrainState, params, micro, dropout_rng):
+def _apply(state: TrainState, params, micro, dropout_rng, quant=None):
+    """Model forward → (output, new_quant). ``quant`` is the delayed-int8
+    amax collection (ops/quant.py); when present the apply is mutable over
+    it and the updated collection comes back for the caller to carry. None
+    (every non-delayed model) leaves the apply exactly as before."""
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
-    return state.apply_fn(
-        {"params": params},
-        micro["input_ids"],
-        micro.get("attention_mask"),
-        micro.get("token_type_ids"),
-        deterministic=dropout_rng is None,
-        rngs=rngs,
+    kwargs = dict(deterministic=dropout_rng is None, rngs=rngs)
+    if quant is not None:
+        out, updated = state.apply_fn(
+            {"params": params, "quant": quant},
+            micro["input_ids"],
+            micro.get("attention_mask"),
+            micro.get("token_type_ids"),
+            mutable=["quant"],
+            **kwargs,
+        )
+        return out, updated["quant"]
+    return (
+        state.apply_fn(
+            {"params": params},
+            micro["input_ids"],
+            micro.get("attention_mask"),
+            micro.get("token_type_ids"),
+            **kwargs,
+        ),
+        None,
     )
 
 
-def _classification_loss(state: TrainState, params, micro, dropout_rng):
+def calibrate_quant(state: TrainState, micro) -> TrainState:
+    """Populate delayed-int8 amaxes from ONE real microbatch (step-0 scales).
+
+    Delayed scaling quantizes with the previous microbatch's amax; before
+    the first step there is none (init observed a dummy batch of ones), so
+    run one deterministic forward with the quant collection mutable and keep
+    the observed amaxes. No-op for models without delayed quant."""
+    if state.quant is None:
+        return state
+
+    def _cal(st, m):
+        return _apply(st, st.params, m, None, st.quant)[1]
+
+    return state.replace(quant=jax.jit(_cal)(state, micro))
+
+
+def _classification_loss(state: TrainState, params, micro, dropout_rng,
+                         quant=None):
     """Mean masked softmax-CE over one microbatch, in fp32."""
-    logits = _apply(state, params, micro, dropout_rng)
+    logits, new_quant = _apply(state, params, micro, dropout_rng, quant)
     labels = micro["labels"]
     valid = micro.get("valid")
     if valid is None:
@@ -65,7 +99,7 @@ def _classification_loss(state: TrainState, params, micro, dropout_rng):
     )
     denom = jnp.maximum(valid.sum(), 1.0)
     loss = (ce * valid).sum() / denom
-    return loss, logits
+    return loss, (logits, new_quant)
 
 
 def _lm_shift_and_mask(micro):
@@ -92,15 +126,16 @@ def _lm_shift_and_mask(micro):
     return targets, mask
 
 
-def _causal_lm_loss(state: TrainState, params, micro, dropout_rng):
+def _causal_lm_loss(state: TrainState, params, micro, dropout_rng,
+                    quant=None):
     """Mean next-token CE per valid target position, in fp32."""
-    logits = _apply(state, params, micro, dropout_rng)
+    logits, new_quant = _apply(state, params, micro, dropout_rng, quant)
     targets, mask = _lm_shift_and_mask(micro)
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets
     )
     loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return loss, logits
+    return loss, (logits, new_quant)
 
 
 _LOSS_FNS = {
@@ -150,18 +185,25 @@ def make_train_step(
         base_rng = jax.random.fold_in(state.dropout_rng, state.step)
 
         def micro_grads(carry, micro):
-            grads_acc, loss_acc = carry
+            grads_acc, loss_acc, quant = carry
             step_rng = jax.random.fold_in(base_rng, loss_acc[1].astype(jnp.int32))
 
             def loss_fn(p):
-                loss, _ = forward_loss(state, p, micro, step_rng)
-                return loss * inv_accum
+                loss, (_, new_quant) = forward_loss(
+                    state, p, micro, step_rng, quant
+                )
+                return loss * inv_accum, new_quant
 
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            (loss, new_quant), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
             grads = jax.tree.map(
                 lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
             )
-            return (grads, (loss_acc[0] + loss, loss_acc[1] + 1.0)), None
+            return (
+                (grads, (loss_acc[0] + loss, loss_acc[1] + 1.0), new_quant),
+                None,
+            )
 
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, acc_dtype), state.params
@@ -170,9 +212,16 @@ def make_train_step(
         # init into the first microbatch's gradients and schedules across
         # iterations (~3 ms/step on the 3-step bert-large recipe); large
         # counts keep the rolled loop for compile-time/code-size sanity.
-        (grads, (loss_sum, _)), _ = jax.lax.scan(
+        # The delayed-quant amax collection rides the same carry (each
+        # microbatch quantizes with the previous one's scales); None for
+        # every other model — an empty pytree in the carry.
+        (grads, (loss_sum, _), final_quant), _ = jax.lax.scan(
             micro_grads,
-            (zero_grads, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
+            (
+                zero_grads,
+                (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                state.quant,
+            ),
             batch,
             unroll=grad_accum_steps <= 4,
         )
@@ -181,7 +230,7 @@ def make_train_step(
         # only materialize a full fp32 copy of every gradient (~3 ms/step
         # on bert-large with a bf16 carry). Optimizer math is fp32 either
         # way (train/fused_adamw.py).
-        new_state = state.apply_gradients(grads)
+        new_state = state.apply_gradients(grads).replace(quant=final_quant)
         metrics = {
             "loss": loss_sum,  # sum of 1/accum-scaled losses == mean loss
         }
@@ -241,7 +290,11 @@ def make_eval_step(
     """
 
     def lm_eval_step(state: TrainState, batch):
-        logits = _apply(state, state.params, batch, None).astype(jnp.float32)
+        # eval quantizes with training's latest amaxes, unmutated (the
+        # updated collection from this forward is discarded)
+        logits = _apply(state, state.params, batch, None, state.quant)[
+            0
+        ].astype(jnp.float32)
         targets, mask = _lm_shift_and_mask(batch)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         preds = jnp.argmax(logits, axis=-1)
@@ -252,7 +305,7 @@ def make_eval_step(
         }
 
     def eval_step(state: TrainState, batch):
-        logits = _apply(state, state.params, batch, None)
+        logits, _ = _apply(state, state.params, batch, None, state.quant)
         preds = jnp.argmax(logits.astype(jnp.float32), axis=-1)
         labels = batch["labels"]
         valid = batch.get("valid")

@@ -105,6 +105,13 @@ class ModelConfig:
     # int8 dgrad/wgrad too. OPT-IN — convergence must be demonstrated
     # per-recipe before a benchmark reports it (NOTES.md int8 section).
     matmul_impl: str = "native"
+    # Delayed (previous-microbatch) activation scaling for the int8 path:
+    # removes the per-site absmax serialization (~9 ms/step on bert-large,
+    # NOTES.md) by carrying amaxes in the flax "quant" collection through
+    # the train state. Requires calibration before step 0 (the Trainer and
+    # bench do it on the first real batch). Only read when matmul_impl is
+    # int8/int8_full; unsupported under the GPipe pipeline trainer.
+    quant_delayed: bool = False
     # Dropout mask generator (ops/dropout.py): "kernel" draws the keep mask
     # from the per-core TPU PRNG inside a Pallas op (only the x-dtype
     # mask-scale tensor touches HBM; falls back to bits32 off-TPU);
@@ -162,6 +169,25 @@ class ModelConfig:
     # mesh "stage" axis (ShardingPolicy(stage=True)) — the 2-stage layer
     # split capability (reference ConcatBert, test_model_parallelism.py:40-89)
     scan_layers: bool = False
+
+    def __post_init__(self):
+        # Validate remat_policy EAGERLY (not only when remat=True in
+        # models.bert.remat_policy): a typo'd --remat-policy, or one set
+        # without --remat, should fail loudly instead of being silently
+        # ignored (ADVICE r3).
+        if self.remat_policy not in ("nothing", "dots", "weight_dots"):
+            raise ValueError(
+                f"remat_policy must be nothing/dots/weight_dots, got "
+                f"{self.remat_policy!r}"
+            )
+        if self.remat_policy != "nothing" and not self.remat:
+            import warnings
+
+            warnings.warn(
+                f"remat_policy={self.remat_policy!r} has no effect without "
+                f"remat=True",
+                stacklevel=2,
+            )
 
     @property
     def head_dim(self) -> int:

@@ -1,16 +1,23 @@
 """Rerun the bert-large recipe (MRPC *shape*: lr 2e-5, 3 epochs, global
 batch 96, seq 128 — on the SYNTHETIC stand-in task, since this image has
-zero egress and no HF hub) across seeds, writing
-HISTORY_bert_large_recipe_seed{N}.json artifacts. VERDICT r2 #4: the
-epoch-1 accuracy/F1 collapse in the original HISTORY artifact (also a
-synthetic-task run) needed a multi-seed reproduction to classify as
-training-dynamics pathology vs framework bug. These runs exercise the
-recipe/optimizer/eval pipeline end-to-end; they say nothing about real
-MRPC label distributions.
+zero egress and no HF hub) across seeds and precision/schedule variants,
+writing HISTORY_bert_large_recipe_seed{N}[{_variant}].json artifacts.
 
-Usage: python scripts/run_recipe_seeds.py [seeds...] (default 42 43 44)
+VERDICT r2 #4 used this for the multi-seed collapse diagnosis; VERDICT r3 #1a
+extends it to the int8 convergence gate: the A/B protocol is one bf16 run and
+one int8 run at the SAME seed on the SAME schedule, compared epoch by epoch.
+
+Usage:
+    python scripts/run_recipe_seeds.py [--seeds 42 43 44]
+        [--matmul-impl native|int8|int8_full] [--quant-delayed]
+        [--warmup-steps N] [--suffix tag]
+
+The artifact name encodes the variant: seed{N}[_int8full][_delayed][_warmup{W}]
+(or an explicit --suffix). These runs exercise the recipe/optimizer/eval
+pipeline end-to-end; they say nothing about real MRPC label distributions.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -19,24 +26,55 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    seeds = [int(s) for s in sys.argv[1:]] or [42, 43, 44]
+    p = argparse.ArgumentParser()
+    # nargs="+": a bare --seeds (or an empty shell expansion) must error,
+    # not silently run zero seeds and exit 0 with no artifacts
+    p.add_argument("--seeds", type=int, nargs="+", default=[42, 43, 44])
+    p.add_argument("--matmul-impl", default="native",
+                   choices=("native", "int8", "int8_full"))
+    p.add_argument("--quant-delayed", action="store_true")
+    p.add_argument("--warmup-steps", type=int, default=None)
+    p.add_argument("--suffix", default=None,
+                   help="artifact suffix override (default: derived)")
+    args = p.parse_args()
+
     from pytorch_distributed_training_tpu.cli import train_dp
 
-    for seed in seeds:
-        history = train_dp.main([
+    suffix = args.suffix
+    if suffix is None:
+        parts = []
+        if args.matmul_impl != "native":
+            parts.append(args.matmul_impl.replace("_", ""))
+        if args.quant_delayed:
+            parts.append("delayed")
+        if args.warmup_steps is not None:
+            parts.append(f"warmup{args.warmup_steps}")
+        suffix = "_" + "_".join(parts) if parts else ""
+
+    for seed in args.seeds:
+        argv = [
             "--model", "bert-large-cased",
             "--task", "synthetic",
             "--micro-batch-size", "24",
             "--seed", str(seed),
             "--log-every", "0",
-        ])
+            "--matmul-impl", args.matmul_impl,
+        ]
+        if args.quant_delayed:
+            argv.append("--quant-delayed")
+        if args.warmup_steps is not None:
+            argv += ["--warmup-steps", str(args.warmup_steps)]
+        history = train_dp.main(argv)
         out = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            f"HISTORY_bert_large_recipe_seed{seed}.json",
+            f"HISTORY_bert_large_recipe_seed{seed}{suffix}.json",
         )
         with open(out, "w") as f:
             json.dump(history, f, indent=1)
-        print(f"seed {seed}: {[{k: r[k] for k in ('epoch', 'accuracy', 'f1')} for r in history]}")
+        print(
+            f"seed {seed}{suffix}: "
+            f"{[{k: r[k] for k in ('epoch', 'accuracy', 'f1')} for r in history]}"
+        )
 
 
 if __name__ == "__main__":

