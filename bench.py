@@ -23,16 +23,21 @@ sweet spot —
 same global batch semantics, best MXU occupancy. Override with
 --micro-batch-size/--global-batch-size for other splits.
 
-Matmul precision: the dense matmuls run on the MXU's 2x-rate int8 tier with
-dynamic quantization (ops/quant.py; per-channel weight scales, per-tensor
-activation/gradient scales, STE backward) — everything else (attention
-math, softmax/LN stats, residual stream, optimizer) keeps the bf16/fp32
-policy. bf16 plateaus at ~615 samples/s/chip on this chip with the dots at
-~90% of peak (NOTES.md r3 ledger) — the int8 tier is the hardware's
-remaining throughput lever, and it is convergence-gated: the 3-epoch
-recipe A/B vs bf16 at the same seed matches eval metrics
-(HISTORY_bert_large_recipe_seed42_int8full.json vs ..._seed42.json;
-NOTES.md int8 section). ``--matmul-impl native`` reverts to pure bf16.
+Matmul precision: the dense matmuls run on the MXU's 2x-rate int8 tier
+(ops/quant.py; per-channel weight scales, per-tensor activation/gradient
+scales, STE backward) with DELAYED activation scaling — each site
+quantizes with the previous microbatch's amax carried in the train state,
+removing the absmax-before-quantize serialization (~9 ms/step; 726 → 766
+samples/s/chip). Everything else (attention math, softmax/LN stats,
+residual stream, optimizer) keeps the bf16/fp32 policy. bf16 plateaus at
+~615 samples/s/chip on this chip with the dots at ~90% of peak (NOTES.md
+r3 ledger) — the int8 tier is the hardware's remaining throughput lever,
+and it is convergence-gated across THREE seeds on BOTH schedules: the
+3-epoch recipe A/B vs bf16 lands inside the bf16 ensemble's band every
+time (HISTORY_bert_large_recipe_seed{42,43,44}_int8full_delayed*.json vs
+the bf16/_int8full artifacts; NOTES.md int8 section). ``--matmul-impl
+native`` reverts to pure bf16; ``--no-quant-delayed`` keeps dynamic
+scales.
 """
 
 from __future__ import annotations
@@ -42,7 +47,19 @@ import json
 import sys
 import time
 
-BASELINE_SAMPLES_PER_SEC_PER_CHIP = 660.0  # 2x A100 (north star, BASELINE.md)
+# Per-model baselines. The reference publishes NO numbers (BASELINE.md);
+# the only driver-set target is the bert-large north star: 2x an A100's
+# fp16 BERT-large fine-tune throughput (~330 samples/s at seq 128). Other
+# models have no sanctioned denominator — their vs_baseline is null rather
+# than a misleading ratio against the bert-large constant (VERDICT r3
+# weak-#3: BENCH_gpt2_medium.json carried vs_baseline 0.0676 against 660).
+MODEL_BASELINES = {
+    "bert-large-cased": {
+        "value": 660.0,
+        "note": "2x A100 fp16 BERT-large MRPC fine-tune (north star)",
+        "precision": "fp16 AMP (A100)",
+    },
+}
 
 
 def run_bench(
@@ -55,7 +72,7 @@ def run_bench(
     repeats: int = 3,
     chain_steps: int = 1,
     matmul_impl: str = "default",
-    quant_delayed: bool = False,
+    quant_delayed: bool | None = None,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -100,6 +117,10 @@ def run_bench(
             "int8_full" if model_name == "bert-large-cased" else "native"
         )
     mcfg.matmul_impl = matmul_impl
+    if quant_delayed is None:
+        # default ON for the int8 tiers: multi-seed convergence-gated
+        # (module docstring) and +40 samples/s/chip over dynamic scales
+        quant_delayed = matmul_impl in ("int8", "int8_full")
     if quant_delayed:
         if matmul_impl not in ("int8", "int8_full"):
             raise SystemExit(
@@ -277,11 +298,24 @@ def run_bench(
     }
     if chain_steps > 1:
         extra["chain_steps"] = chain_steps
+    baseline = MODEL_BASELINES.get(model_name)
+    if baseline:
+        extra["baseline"] = baseline["note"]
+        # the denominator's precision differs from an int8-tier headline;
+        # record it so downstream comparisons can't silently conflate tiers
+        extra["baseline_precision"] = baseline["precision"]
+        vs = round(sps_chip / baseline["value"], 4)
+    else:
+        extra["baseline"] = (
+            "none: reference publishes no numbers and the driver's "
+            "north-star ratio is defined for bert-large-cased only"
+        )
+        vs = None
     return {
         "metric": f"{model_name} {recipe} fine-tune throughput (seq {seq_len}, global batch {global_batch}, {precision})",
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 4),
+        "vs_baseline": vs,
         "extra": extra,
     }
 
@@ -302,10 +336,13 @@ def main(argv=None):
                         "int8_full for the convergence-gated bert-large "
                         "recipe, native elsewhere; picking int8 explicitly "
                         "for an ungated recipe is on the caller")
-    p.add_argument("--quant-delayed", action="store_true",
+    p.add_argument("--quant-delayed", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="delayed (previous-microbatch) int8 activation "
                         "scaling — removes the per-site absmax "
-                        "serialization (ops/quant.py)")
+                        "serialization (ops/quant.py). Default: on for "
+                        "int8 impls (multi-seed convergence-gated), "
+                        "meaningless otherwise")
     args = p.parse_args(argv)
     result = run_bench(
         model_name=args.model,

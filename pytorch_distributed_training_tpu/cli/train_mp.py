@@ -47,12 +47,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--task", default="auto",
                    help="mrpc | mnli | synthetic | auto (mrpc w/ fallback)")
     p.add_argument("--mp-mode", default="branch",
-                   choices=["branch", "stage", "pipeline"],
+                   choices=["branch", "stage", "pipeline", "1f1b"],
                    help="branch = TriBert-style ensemble over the model axis; "
                         "stage = ConcatBert-style layer split over the stage "
                         "axis (serial GSPMD sharding); pipeline = the same "
                         "layer split run through the GPipe schedule "
-                        "(microbatches stream through stages concurrently)")
+                        "(microbatches stream through stages concurrently); "
+                        "1f1b = one-forward-one-backward schedule (same "
+                        "split, backward interleaved with forward, "
+                        "stage-bounded activation memory)")
     p.add_argument("--n-branches", type=int, default=3)
     p.add_argument("--pipeline-microbatches", type=int, default=0,
                    help="GPipe microbatches per train microbatch (pipeline "
@@ -79,14 +82,38 @@ def main(argv=None) -> list[dict]:
     mcfg = model_preset(
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
-        scan_layers=args.mp_mode in ("stage", "pipeline"),
+        scan_layers=args.mp_mode in ("stage", "pipeline", "1f1b"),
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp,
         stage=args.mesh_stage, model=args.mesh_model, seq=args.mesh_seq,
     )
+    def resolve_n_micro(mesh, n, batch, what):
+        """auto/validated pipeline-microbatch count for a batch of rows:
+        deepest of {4,2,1}x stages that divides ``batch`` with the
+        per-microbatch rows divisible over the data axes."""
+        stages = mesh.shape["stage"]
+        dshard = mesh.shape["data"] * mesh.shape["fsdp"]
+        if n <= 0:
+            for cand in (4 * stages, 2 * stages, stages):
+                if batch % cand == 0 and (batch // cand) % dshard == 0:
+                    return cand
+            raise SystemExit(
+                f"no pipeline microbatch count in {{4,2,1}}x{stages} "
+                f"divides {what} {batch} with per-microbatch rows "
+                f"divisible by data*fsdp={dshard}; pick sizes explicitly"
+            )
+        if batch % n or (batch // n) % dshard:
+            raise SystemExit(
+                f"--pipeline-microbatches {n}: {what} {batch} must split "
+                f"into {n} microbatches whose size divides "
+                f"data*fsdp={dshard}"
+            )
+        return n
+
     model_factory = None
+    train_step_factory = None
     if args.mp_mode == "branch":
         if args.mesh_model > 1 and args.n_branches % args.mesh_model:
             raise SystemExit(
@@ -113,49 +140,39 @@ def main(argv=None) -> list[dict]:
             def model_factory(
                 mesh, _cfg=mcfg, _n=args.pipeline_microbatches,
                 _micro=tcfg.micro_batch_size,
-                _eval=tcfg.eval_batch_size,
             ):
-                # auto n_micro: deepest stream that still leaves each
-                # pipeline microbatch divisible over the data axes (GPipe
-                # wants n_micro >= stages; more microbatches = smaller
-                # bubble). Explicit --pipeline-microbatches skips the
-                # search but keeps the validation.
-                stages = mesh.shape["stage"]
-                dshard = mesh.shape["data"] * mesh.shape["fsdp"]
-                if _n <= 0:
-                    for cand in (4 * stages, 2 * stages, stages):
-                        if all(
-                            b % cand == 0 and (b // cand) % dshard == 0
-                            for b in (_micro, _eval)
-                        ):
-                            _n = cand
-                            break
-                    else:
-                        raise SystemExit(
-                            f"no pipeline microbatch count in "
-                            f"{{4,2,1}}x{stages} divides micro-batch "
-                            f"{_micro} AND eval-batch {_eval} with "
-                            f"per-microbatch batch divisible by "
-                            f"data*fsdp={dshard}; pick sizes explicitly"
-                        )
-                for bname, bsz in (
-                    ("micro-batch", _micro),
-                    # evaluate() streams eval batches through the SAME
-                    # pipelined model — catch a bad eval size up front, not
-                    # after a full training epoch
-                    ("eval-batch", _eval),
-                ):
-                    if bsz % _n or (bsz // _n) % dshard:
-                        raise SystemExit(
-                            f"--pipeline-microbatches {_n}: {bname} "
-                            f"{bsz} must split into {_n} microbatches whose "
-                            f"size divides data*fsdp={dshard}"
-                        )
-                return GPipeClassifier(_cfg, mesh, _n)
+                # Only the TRAIN micro batch is constrained: evaluate()
+                # runs through the serial trunk (GPipeClassifier.
+                # serial_apply), so any eval batch the loader accepts works.
+                return GPipeClassifier(
+                    _cfg, mesh,
+                    resolve_n_micro(mesh, _n, _micro, "micro-batch"),
+                )
+
+        elif args.mp_mode == "1f1b":
+            # serial scan model stays for init/eval; training runs the
+            # one-forward-one-backward schedule (parallel/pipeline.py:
+            # make_1f1b_train_step) over the SAME param tree
+            from pytorch_distributed_training_tpu.parallel.pipeline import (
+                make_1f1b_train_step,
+            )
+
+            def train_step_factory(
+                mesh, shardings, _cfg=mcfg, _n=args.pipeline_microbatches,
+                _t=tcfg,
+            ):
+                return make_1f1b_train_step(
+                    _cfg, mesh, shardings,
+                    n_micro=resolve_n_micro(
+                        mesh, _n, _t.micro_batch_size, "micro-batch"
+                    ),
+                    grad_accum_steps=_t.grad_accum_steps,
+                    accum_dtype=_t.grad_accum_dtype,
+                )
 
     trainer = Trainer(
         mcfg, tcfg, mesh_cfg, policy, task=args.task, model=model,
-        model_factory=model_factory,
+        model_factory=model_factory, train_step_factory=train_step_factory,
     )
     return trainer.run()
 

@@ -226,6 +226,285 @@ def gpipe_apply(
     return out[-1]
 
 
+def one_f_one_b_grads(
+    mesh: Mesh,
+    layer_fn: Callable,
+    head_fn: Callable,
+    stacked_params,
+    head_params,
+    xs,
+    biases,
+    labels,
+    *,
+    axis: str = "stage",
+    stream_spec: P | None = None,
+    mb_keys=None,
+    rng_impl=None,
+):
+    """1F1B-scheduled pipeline TRAINING pass → (loss, grads, input cotangents).
+
+    Where :func:`gpipe_apply` is a forward whose backward ``jax.grad``
+    derives (keeping every microbatch's activations alive — O(n_micro)
+    memory), this runs the classic one-forward-one-backward schedule: the
+    per-microbatch loss is computed INSIDE the last stage the moment that
+    microbatch's forward finishes, so its backward starts immediately and
+    interleaves with the remaining forwards. Peak activation stash per
+    stage is bounded by the STAGE count (a [2·n_stages] circular buffer of
+    block inputs; the block's internals recompute in the backward tick,
+    the same trade ``cfg.remat`` makes under GPipe) instead of the
+    microbatch count — the property that lets deep pipelines raise
+    n_micro (smaller bubble) without growing memory. Total ticks:
+    ``n_micro + 2(n_stages-1)`` vs GPipe's ``2(n_micro + n_stages - 1)``
+    for forward+backward — F and B share ticks at steady state.
+
+    Args (beyond :func:`gpipe_apply`'s):
+        head_fn: ``(head_params, y, labels_mb) -> scalar loss`` for ONE
+            microbatch — pooler/classifier/CE evaluated at the last stage
+            (``(hp, y, lab, rng)`` when ``mb_keys`` is given). With a
+            sharded ``stream_spec`` it sees only the LOCAL rows of the
+            microbatch, so use SUM-based losses scaled by the GLOBAL row
+            count — the engine psums loss and parameter gradients across
+            the stream shards (unlike :func:`gpipe_apply`, whose grads
+            form OUTSIDE shard_map where GSPMD inserts the reductions).
+        head_params: its param pytree (replicated to every stage).
+        labels: [n_micro, mb] integer labels streamed with the batch.
+
+    Returns:
+        (loss_sum, trunk_grads [L, ...], head_grads, d_xs [n_micro, ...])
+        — ``d_xs`` are the cotangents at the trunk input, for the caller
+        to feed the embedding backward (embeddings live outside the
+        pipeline, as in the reference's ConcatBert split).
+
+    The schedule (stage s, tick t; S = n_stages):
+        forward of microbatch f = t - s;   backward of b = t - 2(S-1) + s.
+        The last stage's F and B of the same microbatch share a tick (its
+        head vjp bridges them); cotangents hop the reverse ring. Inactive
+        (fill/drain) F/B ticks compute on garbage and mask their writes —
+        bubble fraction ``2(S-1) / (n_micro + 2(S-1))``.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = xs.shape[0]
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {n_stages} stages"
+        )
+    if n_micro < n_stages:
+        raise ValueError(
+            f"need n_micro >= n_stages for a useful pipeline "
+            f"(got {n_micro} < {n_stages})"
+        )
+    if mb_keys is not None and rng_impl is None:
+        raise ValueError("mb_keys requires rng_impl (jax.random.key_impl)")
+    stash_size = 2 * n_stages  # max residual lifetime is 2(S-1) ticks
+
+    shard_axes: tuple = ()
+    if stream_spec is not None:
+        for entry in stream_spec:
+            if entry is None:
+                continue
+            shard_axes += entry if isinstance(entry, tuple) else (entry,)
+
+    layers_per_stage = num_layers // n_stages
+
+    def local_block(params_local, x, b, key=None):
+        if key is None:
+
+            def body(h, lp):
+                return layer_fn(lp, h, b), None
+
+            out, _ = jax.lax.scan(body, x, params_local)
+        else:
+            layer_idx = jnp.arange(layers_per_stage, dtype=jnp.int32)
+
+            def body(h, lp_i):
+                lp, li = lp_i
+                return layer_fn(lp, h, b, jax.random.fold_in(key, li)), None
+
+            out, _ = jax.lax.scan(body, x, (params_local, layer_idx))
+        return out
+
+    def inner(params_local, head_p, xs_, biases_, labels_, *maybe_keys):
+        from pytorch_distributed_training_tpu.ops import dispatch
+
+        with dispatch.manual_region():
+            return _inner_body(
+                params_local, head_p, xs_, biases_, labels_, *maybe_keys
+            )
+
+    def _inner_body(params_local, head_p, xs_, biases_, labels_, *maybe_keys):
+        stage = jax.lax.axis_index(axis)
+        last = n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def derive_key(mb_idx):
+            if not maybe_keys:
+                return None
+            kd = jax.lax.dynamic_index_in_dim(
+                maybe_keys[0], mb_idx, axis=0, keepdims=False
+            )
+            key = jax.random.fold_in(
+                jax.random.wrap_key_data(kd, impl=rng_impl), stage
+            )
+            if shard_axes:
+                from pytorch_distributed_training_tpu.ops import dispatch
+
+                key = jax.random.fold_in(
+                    key, dispatch.linear_device_index(shard_axes, mesh)
+                )
+            return key
+
+        def masked_add(acc, upd, active):
+            m = active.astype(jnp.float32)
+            return jax.tree.map(
+                lambda a, u: a + (u * m).astype(a.dtype), acc, upd
+            )
+
+        def tick(carry, t):
+            fbuf, bbuf, stash, tg, hg, loss_sum, dxs = carry
+
+            # ---------------- forward of microbatch f = t - stage
+            mb_f = t - stage
+            f_act = jnp.logical_and(mb_f >= 0, mb_f < n_micro)
+            mb_f_c = jnp.clip(mb_f, 0, n_micro - 1)
+            x_in = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(xs_, mb_f_c, 0, keepdims=False),
+                fbuf,
+            )
+            b_f = jax.lax.dynamic_index_in_dim(
+                biases_, mb_f_c, 0, keepdims=False
+            )
+            key_f = derive_key(mb_f_c)
+            y = local_block(params_local, x_in, b_f, key_f)
+            # stash the block INPUT (internals recompute in the B tick)
+            slot_f = mb_f_c % stash_size
+            prev_slot = jax.lax.dynamic_index_in_dim(
+                stash, slot_f, 0, keepdims=False
+            )
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(f_act, x_in, prev_slot), slot_f, 0
+            )
+
+            # last stage: head F+B for mb_f right now (bridges F into B)
+            lab_f = jax.lax.dynamic_index_in_dim(
+                labels_, mb_f_c, 0, keepdims=False
+            )
+            if key_f is None:
+                hfn = lambda hp, yy: head_fn(hp, yy, lab_f)  # noqa: E731
+            else:
+                # distinct from the layer folds 0..layers_per_stage-1
+                head_key = jax.random.fold_in(key_f, layers_per_stage)
+                hfn = lambda hp, yy: head_fn(  # noqa: E731
+                    hp, yy, lab_f, head_key
+                )
+            (loss_mb, (dhp, dy)) = jax.value_and_grad(
+                hfn, argnums=(0, 1)
+            )(head_p, y)
+            head_act = jnp.logical_and(f_act, stage == last)
+            hg = masked_add(hg, dhp, head_act)
+            loss_sum = loss_sum + jnp.where(head_act, loss_mb, 0.0)
+
+            # ---------------- backward of microbatch b = t - 2(S-1) + stage
+            mb_b = t - 2 * (n_stages - 1) + stage
+            b_act = jnp.logical_and(mb_b >= 0, mb_b < n_micro)
+            mb_b_c = jnp.clip(mb_b, 0, n_micro - 1)
+            slot_b = mb_b_c % stash_size
+            x_b = jax.lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+            b_b = jax.lax.dynamic_index_in_dim(
+                biases_, mb_b_c, 0, keepdims=False
+            )
+            key_b = derive_key(mb_b_c)
+            g_in = jnp.where(stage == last, dy, bbuf).astype(y.dtype)
+
+            def block_f(p, x):
+                return local_block(p, x, b_b, key_b)
+
+            _, block_vjp = jax.vjp(block_f, params_local, x_b)
+            dp, dx = block_vjp(g_in)
+            tg = masked_add(tg, dp, b_act)
+            dxs = jax.lax.dynamic_update_index_in_dim(
+                dxs,
+                jnp.where(
+                    jnp.logical_and(b_act, stage == 0),
+                    dx,
+                    jax.lax.dynamic_index_in_dim(
+                        dxs, mb_b_c, 0, keepdims=False
+                    ),
+                ),
+                mb_b_c,
+                0,
+            )
+
+            fbuf = jax.lax.ppermute(y, axis, fwd_perm)
+            bbuf = jax.lax.ppermute(dx, axis, bwd_perm)
+            return (fbuf, bbuf, stash, tg, hg, loss_sum, dxs), None
+
+        zero_x = jnp.zeros_like(xs_[0])
+        carry0 = (
+            zero_x,  # fwd hop buffer
+            zero_x,  # bwd hop buffer (cotangents share x's shape)
+            jnp.zeros((stash_size, *zero_x.shape), zero_x.dtype),
+            jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params_local
+            ),
+            jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), head_p
+            ),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros(xs_.shape, xs_.dtype),
+        )
+        n_ticks = n_micro + 2 * (n_stages - 1)
+        (_, _, _, tg, hg, loss_sum, dxs), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(n_ticks, dtype=jnp.int32)
+        )
+        if shard_axes:
+            # the stream is batch-sharded and the grads formed INSIDE this
+            # manual region: sum the per-shard contributions (row-level
+            # outputs like dxs stay sharded)
+            tg = jax.lax.psum(tg, shard_axes)
+            hg = jax.lax.psum(hg, shard_axes)
+            loss_sum = jax.lax.psum(loss_sum, shard_axes)
+        # per-stage results that are only real on ONE stage get a leading
+        # stage dim; the caller selects (same trick as gpipe_apply's outs)
+        return (
+            tg,
+            jax.tree.map(lambda g: g[None], hg),
+            loss_sum[None],
+            dxs[None],
+        )
+
+    stream = stream_spec if stream_spec is not None else P()
+    stacked_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    head_spec = jax.tree.map(lambda _: P(), head_params)
+    label_spec = P(*stream) if stream_spec is not None else P()
+    in_specs = [stacked_spec, head_spec, stream, stream, label_spec]
+    args = [stacked_params, head_params, xs, biases, labels]
+    if mb_keys is not None:
+        in_specs.append(P())
+        args.append(mb_keys)
+    tg, hg, loss, dxs = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(
+            jax.tree.map(lambda _: P(axis), stacked_params),
+            jax.tree.map(lambda _: P(axis), head_params),
+            P(axis),
+            P(axis, *stream),
+        ),
+        check_rep=False,
+    )(*args)
+    # head grads / loss are real on the LAST stage; dxs on stage 0
+    return (
+        loss[-1],
+        tg,
+        jax.tree.map(lambda g: g[-1], hg),
+        dxs[0],
+    )
+
+
 def gpipe_trunk_fn(cfg, *, with_dropout: bool = False):
     """``layer_fn`` for ``gpipe_apply`` from this framework's BertLayer —
     one post-LN encoder layer (models/bert.py). ``with_dropout`` switches
@@ -252,6 +531,193 @@ def gpipe_trunk_fn(cfg, *, with_dropout: bool = False):
     if cfg.remat:
         fn = jax.checkpoint(fn)
     return fn
+
+
+def make_1f1b_train_step(
+    config,
+    mesh: Mesh,
+    state_shardings,
+    *,
+    n_micro: int,
+    grad_accum_steps: int,
+    accum_dtype: str = "float32",
+    batch_axes=("data", "fsdp"),
+):
+    """Jitted classifier train step whose trunk runs the 1F1B schedule.
+
+    The ``--mp-mode 1f1b`` twin of the Trainer's standard step
+    (train/step.py) for ``BertForSequenceClassification(scan_layers=True)``
+    param trees: embeddings forward outside the pipeline (``jax.vjp``
+    bridges its backward from the schedule's input cotangents), the
+    pooler/classifier head INSIDE the last stage so each microbatch's
+    backward starts the moment its forward finishes, gradient accumulation
+    as the usual microbatch scan. Metrics additionally report
+    ``pipeline_bubble`` — the schedule's idle fraction
+    ``2(S-1)/(n_micro + 2(S-1))``.
+
+    Memory vs GPipe (``--mp-mode pipeline``): GPipe's jax.grad backward
+    keeps every microbatch's activations alive (O(n_micro) stash per
+    stage); this keeps a [2·n_stages] circular buffer of block INPUTS and
+    recomputes block internals per backward tick — O(n_stages), so
+    n_micro (bubble) scales without memory growth.
+    """
+    import optax
+    from jax.sharding import NamedSharding
+
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+    from pytorch_distributed_training_tpu.models.bert import (
+        BertEmbeddings,
+        default_position_ids,
+    )
+    from pytorch_distributed_training_tpu.ops.attention import (
+        make_attention_bias,
+    )
+
+    cfg = config
+    if cfg.causal:
+        raise ValueError("make_1f1b_train_step is an encoder-classifier step")
+    if not cfg.scan_layers:
+        raise ValueError(
+            "make_1f1b_train_step requires scan_layers=True (the schedule "
+            "shards the stacked layer dim over the stage axis)"
+        )
+    if getattr(cfg, "quant_delayed", False):
+        # same limitation as GPipeClassifier: the schedule applies layers
+        # as raw functions — no flax "quant" collection to thread
+        raise ValueError(
+            "quant_delayed is unsupported under the 1F1B pipeline; use "
+            "dynamic int8 (matmul_impl alone) or the serial trunk"
+        )
+    n_stages = mesh.shape["stage"]
+    emb = BertEmbeddings(cfg)
+    pool = _PoolerHead(cfg)
+    clf = _ClassifierHead(cfg)
+    acc_dtype = jnp.dtype(accum_dtype)
+    inv_accum = 1.0 / grad_accum_steps
+    bubble = 2 * (n_stages - 1) / (n_micro + 2 * (n_stages - 1))
+    dropout_on = cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
+    layer_fn = gpipe_trunk_fn(cfg, with_dropout=dropout_on)
+    stream_spec = P(None, tuple(batch_axes))
+
+    def make_head_fn(mb_rows_global):
+        # SUM-based (engine psums across stream shards — head_fn only sees
+        # local rows): per-row CE / (global rows per pipeline microbatch ×
+        # n_micro × accum) reconstructs the global-batch mean loss exactly
+        denom = mb_rows_global * n_micro * grad_accum_steps
+
+        def head_fn(hp, y, lab, key=None):
+            rngs = {"dropout": key} if key is not None else None
+            pooled = pool.apply(
+                {"params": {"pooler": hp["pooler"]}}, y, key is None,
+                rngs=rngs,
+            )
+            logits = clf.apply(
+                {"params": {"classifier": hp["classifier"]}},
+                pooled, key is None, rngs=rngs,
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), lab
+            )
+            return ce.sum() / denom
+
+        return head_fn
+
+    def train_step(state, batch):
+        base_rng = jax.random.fold_in(state.dropout_rng, state.step)
+
+        def micro_grads(carry, micro):
+            grads_acc, loss_acc = carry
+            step_rng = jax.random.fold_in(
+                base_rng, loss_acc[1].astype(jnp.int32)
+            )
+            params = state.params
+            ids = micro["input_ids"]
+            batch_rows = ids.shape[0]
+            mb = batch_rows // n_micro
+            tt = micro.get("token_type_ids")
+            if tt is None:
+                tt = jnp.zeros_like(ids)
+            pos = default_position_ids(cfg, ids)
+            mask = micro.get("attention_mask")
+            bias = make_attention_bias(mask)
+            if bias is None:
+                bias = jnp.zeros((batch_rows, 1, 1, ids.shape[1]), jnp.float32)
+
+            emb_rng = jax.random.fold_in(step_rng, 0)
+            pipe_rng = jax.random.fold_in(step_rng, 1)
+
+            def emb_fwd(emb_params):
+                return emb.apply(
+                    {"params": emb_params}, ids, tt, pos, not dropout_on,
+                    rngs={"dropout": emb_rng} if dropout_on else None,
+                )
+
+            x, emb_vjp = jax.vjp(emb_fwd, params["bert"]["embeddings"])
+            xs = x.reshape(n_micro, mb, *x.shape[1:])
+            biases = bias.reshape(n_micro, mb, *bias.shape[1:])
+            labels = micro["labels"].reshape(n_micro, mb)
+            mb_keys = rng_impl = None
+            if dropout_on:
+                keys = jax.vmap(
+                    lambda i: jax.random.fold_in(pipe_rng, i)
+                )(jnp.arange(n_micro, dtype=jnp.int32))
+                mb_keys = jax.random.key_data(keys)
+                rng_impl = jax.random.key_impl(pipe_rng)
+
+            loss, tg, hg, dxs = one_f_one_b_grads(
+                mesh, layer_fn, make_head_fn(mb),
+                params["bert"]["layers_scan"]["layer"],
+                {
+                    "pooler": params["bert"]["pooler"],
+                    "classifier": params["classifier"],
+                },
+                xs, biases, labels,
+                stream_spec=stream_spec,
+                mb_keys=mb_keys, rng_impl=rng_impl,
+            )
+            (d_emb,) = emb_vjp(
+                dxs.reshape(batch_rows, *x.shape[1:]).astype(x.dtype)
+            )
+            grads = {
+                "bert": {
+                    "embeddings": d_emb,
+                    "layers_scan": {"layer": tg},
+                    "pooler": hg["pooler"],
+                },
+                "classifier": hg["classifier"],
+            }
+            grads_acc = jax.tree.map(
+                lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
+            )
+            return (grads_acc, (loss_acc[0] + loss, loss_acc[1] + 1.0)), None
+
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, acc_dtype), state.params
+        )
+        (grads, (loss_sum, _)), _ = jax.lax.scan(
+            micro_grads,
+            (
+                zero_grads,
+                (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            ),
+            batch,
+            unroll=grad_accum_steps <= 4,
+        )
+        new_state = state.apply_gradients(grads)
+        return new_state, {
+            "loss": loss_sum,
+            "pipeline_bubble": jnp.float32(bubble),
+        }
+
+    return jax.jit(
+        train_step,
+        donate_argnums=(0,),
+        in_shardings=(
+            state_shardings,
+            NamedSharding(mesh, TRAIN_BATCH_PSPEC),
+        ),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+    )
 
 
 class _PoolerHead(nn.Module):
@@ -336,6 +802,15 @@ class GPipeClassifier:
 
     def init(self, rngs, *args, **kwargs):
         return self._inner.init(rngs, *args, **kwargs)
+
+    @property
+    def serial_apply(self):
+        """Apply the SAME params through the serial scan trunk (no pipeline
+        schedule). The param tree is identical by design, so this is free —
+        the Trainer evaluates through it (train.step.make_eval_step
+        ``apply_fn``), which removes the eval-batch n_micro × data-shard
+        divisibility constraint and the per-eval-batch fill/drain bubble."""
+        return self._inner.apply
 
     def apply(
         self,

@@ -59,6 +59,7 @@ class Trainer:
         model=None,
         model_factory=None,
         hf_checkpoint=None,
+        train_step_factory=None,
     ):
         self.mcfg = model_config
         self.tcfg = train_config
@@ -223,16 +224,54 @@ class Trainer:
             micro0 = make_global_batch(self.mesh, rows, pspec=P(BATCH_AXES))
             self.state = calibrate_quant(self.state, micro0)
 
-        self.train_step = make_train_step(
-            grad_accum_steps=train_config.grad_accum_steps,
-            mesh=self.mesh,
-            state_shardings=self.shardings,
-            objective=self.objective,
-            accum_dtype=train_config.grad_accum_dtype,
-        )
+        chain = train_config.chain_steps
+        if chain > 1:
+            # chained dispatch must tile every step-indexed cadence: a chain
+            # crossing an epoch (or checkpoint/crash point) would tear the
+            # per-epoch eval/resume contract
+            spe = self.train_loader.steps_per_epoch
+            bad = next(
+                (
+                    (what, n)
+                    for what, n in (
+                        ("steps_per_epoch", spe),
+                        ("checkpoint_every_steps",
+                         train_config.checkpoint_every_steps),
+                        ("crash_at_step", train_config.crash_at_step),
+                    )
+                    if n and n % chain
+                ),
+                None,
+            )
+            if bad:
+                raise ValueError(
+                    f"chain_steps={chain} must divide {bad[0]}={bad[1]}"
+                )
+        if train_step_factory is not None:
+            # custom schedules (the 1F1B pipeline step,
+            # parallel/pipeline.py) replace the standard step wholesale;
+            # they own their accumulation/loss contract
+            if chain > 1:
+                raise ValueError(
+                    "chain_steps > 1 is not supported with a custom "
+                    "train_step_factory"
+                )
+            self.train_step = train_step_factory(self.mesh, self.shardings)
+        else:
+            self.train_step = make_train_step(
+                grad_accum_steps=train_config.grad_accum_steps,
+                mesh=self.mesh,
+                state_shardings=self.shardings,
+                objective=self.objective,
+                accum_dtype=train_config.grad_accum_dtype,
+                chain_steps=chain,
+            )
         self.eval_step = make_eval_step(
             mesh=self.mesh, state_shardings=self.shardings,
             objective=self.objective,
+            # pipeline models evaluate through their serial trunk (same
+            # params, no schedule) — see GPipeClassifier.serial_apply
+            apply_fn=getattr(self.model, "serial_apply", None),
         )
         self.history: list[dict] = []
 
@@ -322,16 +361,42 @@ class Trainer:
                 # host-device sync every step and serialize dispatch
                 step_no = epoch * self.train_loader.steps_per_epoch
                 skip = skip_in_first_epoch if epoch == start_epoch else 0
+                chain = cfg.chain_steps
+                if chain > 1 and skip % chain:
+                    # cadence validation (__init__) keeps every checkpoint
+                    # on a chain boundary, so a legal resume never lands here
+                    raise RuntimeError(
+                        f"resume step {skip} is mid-chain (chain_steps="
+                        f"{chain}) — checkpoint written by a different "
+                        f"chain configuration?"
+                    )
+                buf = []
                 for i, batch in enumerate(self.train_loader.epoch(epoch)):
                     if i < skip:
                         step_no += 1
                         continue
+                    if chain > 1:
+                        # ONE dispatch per chain_steps updates: stack the
+                        # placed batches on a leading chain dim (device-side
+                        # concat; the extra copy is batch-sized, ~negligible
+                        # next to a step) and let the scan-chained step
+                        # (train/step.py) run them back-to-back
+                        buf.append(batch)
+                        if len(buf) < chain:
+                            continue
+                        batch = jax.tree.map(
+                            lambda *xs: jnp.stack(xs), *buf
+                        )
+                        buf.clear()
                     with annotate("train_step"):
                         self.state, metrics = self.train_step(self.state, batch)
-                    samples += cfg.global_batch_size
+                    samples += cfg.global_batch_size * chain
                     losses.append(metrics["loss"])
-                    step_no += 1
-                    if cfg.log_every and step_no % cfg.log_every == 0:
+                    step_no += chain
+                    if cfg.log_every and (
+                        step_no // cfg.log_every
+                        > (step_no - chain) // cfg.log_every
+                    ):
                         log0(
                             f"step {step_no}: loss="
                             f"{float(jax.device_get(metrics['loss'])):.4f} "

@@ -40,15 +40,19 @@ from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES, TRAIN_BATCH_
 from pytorch_distributed_training_tpu.train.state import TrainState
 
 
-def _apply(state: TrainState, params, micro, dropout_rng, quant=None):
+def _apply(state: TrainState, params, micro, dropout_rng, quant=None,
+           apply_fn=None):
     """Model forward → (output, new_quant). ``quant`` is the delayed-int8
     amax collection (ops/quant.py); when present the apply is mutable over
     it and the updated collection comes back for the caller to carry. None
-    (every non-delayed model) leaves the apply exactly as before."""
+    (every non-delayed model) leaves the apply exactly as before.
+    ``apply_fn`` overrides ``state.apply_fn`` (the pipeline trainer
+    evaluates through the serial trunk — same params, no schedule)."""
+    fn = state.apply_fn if apply_fn is None else apply_fn
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     kwargs = dict(deterministic=dropout_rng is None, rngs=rngs)
     if quant is not None:
-        out, updated = state.apply_fn(
+        out, updated = fn(
             {"params": params, "quant": quant},
             micro["input_ids"],
             micro.get("attention_mask"),
@@ -58,7 +62,7 @@ def _apply(state: TrainState, params, micro, dropout_rng, quant=None):
         )
         return out, updated["quant"]
     return (
-        state.apply_fn(
+        fn(
             {"params": params},
             micro["input_ids"],
             micro.get("attention_mask"),
@@ -279,6 +283,7 @@ def make_eval_step(
     mesh: Optional[Mesh] = None,
     state_shardings=None,
     objective: str = "classification",
+    apply_fn=None,
 ) -> Callable:
     """Build the jitted eval step → replicated scalar counts.
 
@@ -287,14 +292,20 @@ def make_eval_step(
     class for binary F1 is label 1 (GLUE/MRPC convention).
     causal_lm: {"nll_sum", "token_count", "token_correct"} — folds into
     ``LMMetricAccumulator`` (eval loss / perplexity / token accuracy).
+
+    ``apply_fn`` evaluates through a DIFFERENT apply than training's over
+    the same params — the pipeline trainer's serial-trunk eval (the GPipe
+    param tree is identical to the serial scan model's by design), which
+    frees eval batches from the n_micro × data-shard divisibility the
+    schedule needs and skips the fill/drain bubble per eval batch.
     """
 
     def lm_eval_step(state: TrainState, batch):
         # eval quantizes with training's latest amaxes, unmutated (the
         # updated collection from this forward is discarded)
-        logits = _apply(state, state.params, batch, None, state.quant)[
-            0
-        ].astype(jnp.float32)
+        logits = _apply(
+            state, state.params, batch, None, state.quant, apply_fn
+        )[0].astype(jnp.float32)
         targets, mask = _lm_shift_and_mask(batch)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         preds = jnp.argmax(logits, axis=-1)
@@ -305,7 +316,9 @@ def make_eval_step(
         }
 
     def eval_step(state: TrainState, batch):
-        logits, _ = _apply(state, state.params, batch, None, state.quant)
+        logits, _ = _apply(
+            state, state.params, batch, None, state.quant, apply_fn
+        )
         preds = jnp.argmax(logits.astype(jnp.float32), axis=-1)
         labels = batch["labels"]
         valid = batch.get("valid")

@@ -235,3 +235,37 @@ def test_checkpoint_restore_across_topologies(mini_trained, tmp_path):
     dp.state, metrics = dp.train_step(dp.state, batch)
     assert int(jax.device_get(dp.state.step)) == step_before + 1
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+@pytest.mark.slow
+def test_trainer_chain_steps_matches_per_step(eight_devices):
+    """--chain-steps N (one dispatch per N optimizer updates) must walk the
+    exact per-step trajectory: same final params, same eval metrics. Pins
+    the Trainer wiring on top of the step-level parity test
+    (test_train.py::test_chained_steps_match_per_step)."""
+    import jax
+
+    t1 = small_trainer(num_epochs=1, train_size=128, eval_size=32)
+    h1 = t1.run()
+    t2 = small_trainer(num_epochs=1, train_size=128, eval_size=32,
+                       chain_steps=2)
+    h2 = t2.run()
+    assert int(jax.device_get(t1.state.step)) == int(
+        jax.device_get(t2.state.step)
+    )
+    a = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(t1.state.params)]
+    )
+    b = np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(t2.state.params)]
+    )
+    np.testing.assert_allclose(a, b, atol=2e-5)
+    assert h1[0]["accuracy"] == pytest.approx(h2[0]["accuracy"], abs=1e-6)
+
+
+def test_trainer_chain_steps_cadence_validation(eight_devices):
+    """chain_steps must divide steps_per_epoch and the checkpoint cadence —
+    a chain crossing an epoch would tear the per-epoch eval contract."""
+    with pytest.raises(ValueError, match="chain_steps"):
+        small_trainer(num_epochs=1, train_size=96, eval_size=32,
+                      chain_steps=2)  # 3 updates/epoch, not divisible
