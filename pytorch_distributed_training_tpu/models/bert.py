@@ -77,10 +77,18 @@ class BertEmbeddings(nn.Module):
         )(position_ids)
         x = words + positions
         if cfg.type_vocab_size:
+            # RoBERTa has a SIZE-1 type table (HF parity) while pair tasks
+            # feed segment ids {0,1}: clamp explicitly instead of relying
+            # on XLA's silent OOB-gather clamp. The constant embedding adds
+            # no segment signal — random-init RoBERTa therefore learns
+            # pair tasks noticeably slower than BERT (measured on the
+            # synthetic recipe: the segment cue is the easiest feature,
+            # NOTES.md round-4 RoBERTa section).
+            types = jnp.clip(token_type_ids, 0, cfg.type_vocab_size - 1)
             x = x + nn.Embed(
                 cfg.type_vocab_size, cfg.hidden_size, embedding_init=embed_init,
                 name="token_type_embeddings", **kw,
-            )(token_type_ids)
+            )(types)
         x = _ln(cfg, "norm")(x)
         return Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
             x, deterministic=deterministic

@@ -32,6 +32,27 @@ from pytorch_distributed_training_tpu.ops.dropout import Dropout
 from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
+def _mlp_body(mdl: "GPT2Block", h, deterministic):
+    """The block's MLP tail (mlp_up → gelu → mlp_down → dropout) as a
+    module-first function so ``remat_mlp`` can wrap it in a LIFTED
+    ``nn.remat`` without changing parameter names/paths: children created
+    here register in the block's own scope. Structural (plain
+    jax.checkpoint, no saveable policies) — the tunnel's TPU compiler
+    crashes on checkpoint POLICIES at gpt2-medium scale (NOTES.md), while
+    plain-remat subgraphs compile fine; rematerializing ONLY the MLP drops
+    the [B,S,4·hidden] gelu residuals (the biggest per-layer activations)
+    for one extra mlp_up matmul in the backward."""
+    cfg = mdl.config
+    kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
+              kernel_init=nn.initializers.normal(stddev=0.02))
+    h = dense_general(cfg, cfg.intermediate_size, -1, "mlp_up", kw)(h)
+    h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
+    h = dense_general(cfg, cfg.hidden_size, -1, "mlp_down", kw)(h)
+    return Dropout(cfg.hidden_dropout, cfg.dropout_impl)(
+        h, deterministic=deterministic
+    )
+
+
 class GPT2Block(nn.Module):
     """Pre-LN transformer block (GPT-2 convention — LN before each sublayer,
     unlike BERT's post-LN ``BertLayer``)."""
@@ -41,8 +62,6 @@ class GPT2Block(nn.Module):
     @nn.compact
     def __call__(self, x, attention_bias, deterministic):
         cfg = self.config
-        kw = dict(dtype=_dtype(cfg), param_dtype=_pdtype(cfg),
-                  kernel_init=nn.initializers.normal(stddev=0.02))
         h = _ln(cfg, "ln_1")(x)
         h = BertSelfAttention(cfg, name="attention")(
             h, attention_bias, deterministic
@@ -51,10 +70,12 @@ class GPT2Block(nn.Module):
         x = x + h
 
         h = _ln(cfg, "ln_2")(x)
-        h = dense_general(cfg, cfg.intermediate_size, -1, "mlp_up", kw)(h)
-        h = nn.gelu(h, approximate=True)  # GPT-2 uses the tanh approximation
-        h = dense_general(cfg, cfg.hidden_size, -1, "mlp_down", kw)(h)
-        h = Dropout(cfg.hidden_dropout, cfg.dropout_impl)(h, deterministic=deterministic)
+        mlp = (
+            nn.remat(_mlp_body, static_argnums=(2,))
+            if cfg.remat_mlp
+            else _mlp_body
+        )
+        h = mlp(self, h, deterministic)
         return x + h
 
 

@@ -593,7 +593,6 @@ def make_1f1b_train_step(
     pool = _PoolerHead(cfg)
     clf = _ClassifierHead(cfg)
     acc_dtype = jnp.dtype(accum_dtype)
-    inv_accum = 1.0 / grad_accum_steps
     bubble = 2 * (n_stages - 1) / (n_micro + 2 * (n_stages - 1))
     dropout_on = cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
     layer_fn = gpipe_trunk_fn(cfg, with_dropout=dropout_on)

@@ -157,6 +157,7 @@ def make_train_step(
     accum_dtype: str = "float32",
     chain_steps: int = 1,
     log_grad_norm: bool = True,
+    unroll_accum: Optional[bool] = None,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -212,13 +213,19 @@ def make_train_step(
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, acc_dtype), state.params
         )
-        # Small accumulation counts unroll fully: XLA folds the zeros
-        # init into the first microbatch's gradients and schedules across
-        # iterations (~3 ms/step on the 3-step bert-large recipe); large
-        # counts keep the rolled loop for compile-time/code-size sanity.
+        # Small accumulation counts unroll fully by default: XLA folds the
+        # zeros init into the first microbatch's gradients and schedules
+        # across iterations (~3 ms/step on the 3-step bert-large recipe);
+        # large counts keep the rolled loop for compile-time/code-size
+        # sanity. ``unroll_accum`` overrides — unrolling lets XLA overlap
+        # microbatch LIFETIMES, which raises peak activation memory
+        # (gpt2-medium at micro 8 OOMs unrolled, fits rolled).
         # The delayed-quant amax collection rides the same carry (each
         # microbatch quantizes with the previous one's scales); None for
         # every other model — an empty pytree in the carry.
+        unroll = (
+            grad_accum_steps <= 4 if unroll_accum is None else unroll_accum
+        )
         (grads, (loss_sum, _), final_quant), _ = jax.lax.scan(
             micro_grads,
             (
@@ -227,7 +234,7 @@ def make_train_step(
                 state.quant,
             ),
             batch,
-            unroll=grad_accum_steps <= 4,
+            unroll=unroll,
         )
         # Gradients go to the optimizer in the CARRY dtype — fused_adamw
         # upcasts per-element in-register, so a tree-wide astype here would

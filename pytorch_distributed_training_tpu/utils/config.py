@@ -153,6 +153,14 @@ class ModelConfig:
     #                recompute the batched attention-score einsums too —
     #                between the other two in both memory and FLOPs.
     remat_policy: str = "nothing"
+    # Rematerialize ONLY the MLP tail (mlp_up → gelu → mlp_down) of each
+    # GPT-2 block, structurally (plain jax.checkpoint around the
+    # sub-function, NO saveable policies — those crash the tunnel's TPU
+    # compiler at gpt2-medium scale, NOTES.md). Drops the [B,S,4·hidden]
+    # gelu residuals (the largest per-layer activations) for one extra
+    # mlp_up matmul in the backward — the middle ground between no remat
+    # (OOM at micro 8) and full-layer remat (recomputes attention too).
+    remat_mlp: bool = False
     # Rematerialize the attention core (scores/softmax/probs) in the
     # backward pass instead of saving probs residuals — a strict win on the
     # seq-128 encoder recipe (see models/bert.py); applies to the

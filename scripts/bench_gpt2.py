@@ -36,7 +36,7 @@ from pytorch_distributed_training_tpu.utils.config import (
 GLOBAL, SEQ, ITERS = 32, 1024, 8
 
 
-def run(micro=4, block_q=None, block_k=None, **mkw):
+def run(micro=4, block_q=None, block_k=None, unroll=None, **mkw):
     if block_q or block_k:
         import pytorch_distributed_training_tpu.ops.flash_attention as fa
         fa.DEFAULT_BLOCK_Q = block_q or fa.DEFAULT_BLOCK_Q
@@ -61,6 +61,7 @@ def run(micro=4, block_q=None, block_k=None, **mkw):
     step = make_train_step(
         grad_accum_steps=accum, mesh=mesh, state_shardings=shardings,
         objective="causal_lm", accum_dtype=tcfg.grad_accum_dtype,
+        unroll_accum=unroll,
     )
     rng = np.random.default_rng(0)
     b = {
@@ -80,6 +81,8 @@ def run(micro=4, block_q=None, block_k=None, **mkw):
     flags = " ".join(f"{k}={v}" for k, v in mkw.items())
     if block_q or block_k:
         flags += f" bq={block_q} bk={block_k}"
+    if unroll is not None:
+        flags += f" unroll={unroll}"
     sps = GLOBAL / best
     toks = sps * SEQ
     print(
@@ -90,13 +93,19 @@ def run(micro=4, block_q=None, block_k=None, **mkw):
 
 
 if __name__ == "__main__":
-    for kw in (
+    # combos picked per round; pass python-literal dicts as argv to
+    # override, e.g. scripts/bench_gpt2.py "dict(micro=8, remat_mlp=True)"
+    default = (
         dict(micro=4),
-        dict(micro=4, attention_impl="reference"),
-        dict(micro=4, scan_layers=True),
-        dict(micro=8),
-        dict(micro=2),
-        dict(micro=8, remat=True),
-        dict(micro=16, remat=True),
-    ):
+        dict(micro=6, remat_mlp=True),
+        dict(micro=8, remat_mlp=True),
+        dict(micro=4, remat_mlp=True),
+        dict(micro=16, remat_mlp=True),
+    )
+    combos = (
+        [eval(a, {"dict": dict}) for a in sys.argv[1:]]  # noqa: S307
+        if len(sys.argv) > 1
+        else default
+    )
+    for kw in combos:
         run(**kw)

@@ -107,7 +107,12 @@ def bucket(name: str) -> str:
 def main():
     micro = int(sys.argv[1]) if len(sys.argv) > 1 else 32
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    step, state, batch = build_step(micro)
+    model = sys.argv[3] if len(sys.argv) > 3 else "bert-large-cased"
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else None
+    glob_b = int(sys.argv[5]) if len(sys.argv) > 5 else None
+    step, state, batch = build_step(
+        micro, model_name=model, seq=seq, global_batch=glob_b
+    )
     state, m = step(state, batch)  # compile
     jax.block_until_ready(state.params)
 
