@@ -273,6 +273,7 @@ def test_gpipe_dropout_streams_distinct_per_data_shard(eight_devices):
 # ------------------------------------------------------------ 1F1B schedule
 
 
+@pytest.mark.slow
 def test_one_f_one_b_matches_sequential_grads(setup):
     """The 1F1B engine (interleaved F/B ticks, stage-bounded stash,
     in-schedule head vjp) must produce the SAME loss/gradients as the
@@ -326,6 +327,7 @@ def test_one_f_one_b_matches_sequential_grads(setup):
         )
 
 
+@pytest.mark.slow
 def test_one_f_one_b_stage4(setup):
     """Same parity at 4 stages (deeper fill/drain, wrap-around stash)."""
     import optax
@@ -399,6 +401,7 @@ def test_train_mp_1f1b_e2e(eight_devices):
     assert history[0]["accuracy"] >= 0.0
 
 
+@pytest.mark.slow
 def test_1f1b_step_matches_standard_step_at_dropout0(eight_devices):
     """One 1F1B train step == one standard (serial-trunk) train step on the
     same params/batch at dropout 0 — loss and updated params."""
