@@ -74,6 +74,7 @@ def test_gpipe_matches_sequential_stage4(setup):
     )
 
 
+@pytest.mark.slow
 def test_gpipe_gradients_match(setup):
     """jax.grad THROUGH the pipeline (reverse ppermute = backward
     schedule) equals the sequential trunk's gradients."""
@@ -148,6 +149,7 @@ def test_gpipe_classifier_matches_serial(clf_setup):
     )
 
 
+@pytest.mark.slow
 def test_gpipe_classifier_dropout_grads(clf_setup):
     """Training mode with dropout on: per-(tick, stage, layer) key streaming
     produces finite nonzero grads and actually perturbs the forward."""
