@@ -1,26 +1,34 @@
-"""Prefetching train loader backed by the native C++ batch assembler.
+"""Prefetching loader backed by the native C++ batch assembler.
 
-Same iteration contract as ``pipeline.ShardedLoader`` (train mode): yields
-[grad_accum, local_micro, ...] batches placed as global sharded arrays. The
-difference is WHO assembles: a C++ worker pool (native/src/batcher.cpp)
-gathers permuted rows into a ring of reusable buffers ahead of consumption,
-overlapping host assembly with device compute — the role torch's DataLoader
-workers play in the reference's stack (reference test_data_parallelism.py:
-102-107).
+Same iteration contract as ``pipeline.ShardedLoader``: train mode yields
+[grad_accum, local_micro, ...] batches placed as global sharded arrays;
+eval mode (``train=False``) yields [local_batch, ...] with a ``valid``
+mask, every example exactly once, ragged tail padded. The difference is
+WHO assembles: a C++ worker pool (native/src/batcher.cpp) gathers rows
+into a ring of reusable buffers ahead of consumption, overlapping host
+assembly with device compute — the role torch's DataLoader workers play
+in the reference's stack (reference test_data_parallelism.py:102-107).
 
-Cross-host consistency AND engine interchangeability: the epoch permutation
-is computed here with ``np.random.default_rng((seed, epoch)).permutation`` —
-byte-identical to ``pipeline.ShardedLoader``'s order — and handed to the C++
-side. Every process assembles slices of the SAME global batch (the property
-that keeps collectives from deadlocking, SURVEY.md §7 hard parts), and a
-run may checkpoint under one engine and resume under the other with the
-exact data trajectory preserved.
+Cross-host consistency AND engine interchangeability: the train epoch
+permutation is computed here with ``np.random.default_rng((seed,
+epoch)).permutation`` — byte-identical to ``pipeline.ShardedLoader``'s
+order — and handed to the C++ side. Every process assembles slices of the
+SAME global batch (the property that keeps collectives from deadlocking,
+SURVEY.md §7 hard parts), and a run may checkpoint under one engine and
+resume under the other with the exact data trajectory preserved.
+
+Eval rides the SAME C++ gather: the "permutation" is the identity padded
+with row 0 up to a whole number of batches (the C++ side sizes epochs by
+the row count given at create, so passing the padded count makes the
+ragged tail a full step; pad gathers are in-bounds reads of row 0 whose
+outputs are masked off), and the ``valid`` mask — position < n — is
+attached host-side per step.
 
 Slot lifetime: a yielded batch's host buffers live in a ring slot. The slot
 is released two iterations later, after ``jax.block_until_ready`` on the
 batch that lived there confirms its H2D transfer finished (normally a no-op
 by then, keeping the release off the critical path). Integer datasets only
-(the GLUE/LM contract); eval mode is served by the Python loader.
+(the GLUE/LM contract).
 """
 
 from __future__ import annotations
@@ -41,7 +49,7 @@ _WORKERS = 2
 
 
 class NativeShardedLoader:
-    """Drop-in for ``ShardedLoader(train=True)`` with C++ prefetch."""
+    """Drop-in for ``ShardedLoader`` (train or eval) with C++ prefetch."""
 
     def __init__(
         self,
@@ -51,6 +59,7 @@ class NativeShardedLoader:
         global_batch_size: int,
         grad_accum_steps: int = 1,
         seed: int = 42,
+        train: bool = True,
         process_index: int | None = None,
         process_count: int | None = None,
     ):
@@ -64,8 +73,8 @@ class NativeShardedLoader:
         self.mesh = mesh
         self.seed = seed
         self.global_batch = global_batch_size
-        self.accum = grad_accum_steps
-        self.train = True
+        self.accum = grad_accum_steps if train else 1
+        self.train = train
 
         from pytorch_distributed_training_tpu.data.pipeline import (
             resolve_batch_geometry,
@@ -76,7 +85,7 @@ class NativeShardedLoader:
                 mesh,
                 global_batch_size=global_batch_size,
                 grad_accum_steps=grad_accum_steps,
-                train=True,
+                train=train,
                 process_index=process_index,
                 process_count=process_count,
             )
@@ -101,6 +110,16 @@ class NativeShardedLoader:
         ]
         self._row_shapes = [a.shape[1:] for a in self._arrays]
 
+        # Eval pads the ragged tail into a full final step: the C++ side
+        # sizes epochs by THIS row count, and the identity "permutation" we
+        # hand it is padded with in-bounds row-0 entries (masked off via
+        # ``valid``). Train keeps exact rows (ragged tail dropped, the
+        # Python loader's train semantics).
+        if train:
+            self._n_epoch_rows = self.n
+        else:
+            gb = self.global_batch
+            self._n_epoch_rows = ((self.n + gb - 1) // gb) * gb
         arr_ptrs = (ctypes.c_void_p * len(self._arrays))(
             *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays]
         )
@@ -109,7 +128,7 @@ class NativeShardedLoader:
             arr_ptrs,
             row_elems,
             len(self._arrays),
-            self.n,
+            self._n_epoch_rows,
             self.accum,
             micro_global,
             micro_local,
@@ -121,16 +140,23 @@ class NativeShardedLoader:
 
     @property
     def steps_per_epoch(self) -> int:
-        return self.n // self.global_batch
+        return self._n_epoch_rows // self.global_batch
 
     def epoch(self, epoch_index: int = 0) -> Iterator[dict]:
         lib = self._lib
-        # SAME permutation as pipeline.ShardedLoader._train_epoch — the two
-        # engines must be interchangeable mid-run (mid-epoch resume).
-        perm = np.ascontiguousarray(
-            np.random.default_rng((self.seed, epoch_index)).permutation(self.n),
-            dtype=np.int64,
-        )
+        if self.train:
+            # SAME permutation as pipeline.ShardedLoader._train_epoch — the
+            # two engines must be interchangeable mid-run (mid-epoch resume).
+            perm = np.ascontiguousarray(
+                np.random.default_rng(
+                    (self.seed, epoch_index)
+                ).permutation(self.n),
+                dtype=np.int64,
+            )
+        else:
+            # identity order, row-0 pad entries (masked via ``valid``)
+            perm = np.zeros(self._n_epoch_rows, np.int64)
+            perm[: self.n] = np.arange(self.n, dtype=np.int64)
         n_steps = lib.batcher_start_epoch(
             self._handle, perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
         )
@@ -146,7 +172,7 @@ class NativeShardedLoader:
             lib.batcher_release(self._handle, slot)
 
         try:
-            for _ in range(n_steps):
+            for step in range(n_steps):
                 slot = lib.batcher_next(self._handle, out_ptrs)
                 if slot < 0:
                     break
@@ -156,9 +182,24 @@ class NativeShardedLoader:
                     n_el = self.accum * self._micro_local * self._row_elems[i]
                     buf = (ctypes.c_int32 * n_el).from_address(out_ptrs[i])
                     batch[k] = np.frombuffer(buf, np.int32).reshape(shape)
-                placed = make_global_batch(
-                    self.mesh, batch, pspec=TRAIN_BATCH_PSPEC
-                )
+                if self.train:
+                    placed = make_global_batch(
+                        self.mesh, batch, pspec=TRAIN_BATCH_PSPEC
+                    )
+                else:
+                    # [local_batch, ...] + the per-step validity mask (pad
+                    # rows of the final step masked off) — identical to
+                    # pipeline.ShardedLoader._eval_epoch
+                    batch = {k: v[0] for k, v in batch.items()}
+                    valid_n = min(
+                        self.n - step * self.global_batch, self.global_batch
+                    )
+                    valid_global = (
+                        np.arange(self.global_batch) < valid_n
+                    ).astype(np.int32)
+                    lo = self.pidx * self._micro_local
+                    batch["valid"] = valid_global[lo : lo + self._micro_local]
+                    placed = make_global_batch(self.mesh, batch)
                 yield placed
                 held.append((slot, placed))
                 if len(held) > 2:  # normally a no-op sync by now
