@@ -245,14 +245,26 @@ class Trainer:
                 raise ValueError(
                     f"chain_steps={chain} must divide {bad[0]}={bad[1]}"
                 )
+        if train_config.unroll_accum not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unroll_accum must be auto/on/off, got "
+                f"{train_config.unroll_accum!r}"
+            )
         if train_step_factory is not None:
             # custom schedules (the 1F1B pipeline step,
             # parallel/pipeline.py) replace the standard step wholesale;
-            # they own their accumulation/loss contract
+            # they own their accumulation/loss contract — reject knobs they
+            # would silently ignore rather than let an OOM-motivated
+            # unroll_accum="off" change nothing
             if chain > 1:
                 raise ValueError(
                     "chain_steps > 1 is not supported with a custom "
                     "train_step_factory"
+                )
+            if train_config.unroll_accum != "auto":
+                raise ValueError(
+                    "unroll_accum is not supported with a custom "
+                    "train_step_factory (the schedule owns its scan policy)"
                 )
             self.train_step = train_step_factory(self.mesh, self.shardings)
         else:
@@ -263,6 +275,9 @@ class Trainer:
                 objective=self.objective,
                 accum_dtype=train_config.grad_accum_dtype,
                 chain_steps=chain,
+                unroll_accum={"auto": None, "on": True, "off": False}[
+                    train_config.unroll_accum
+                ],
             )
         self.eval_step = make_eval_step(
             mesh=self.mesh, state_shardings=self.shardings,

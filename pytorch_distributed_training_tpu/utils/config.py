@@ -329,6 +329,13 @@ class TrainConfig:
     # identical; loss/grad-norm metrics come back for the LAST step of each
     # chain only, and logging/checkpoint cadences round to chain boundaries.
     chain_steps: int = 1
+    # Accumulation-scan unrolling: "auto" unrolls when grad_accum_steps <= 4
+    # (XLA folds the zeros init into microbatch 1 and schedules across
+    # iterations, ~3 ms/step on bert-large); "off" forces the rolled loop —
+    # unrolling lets XLA overlap microbatch LIFETIMES, which raises peak
+    # activation memory (gpt2-medium at micro 8 OOMs unrolled, fits rolled
+    # — NOTES.md round-4); "on" forces unrolling regardless of count.
+    unroll_accum: str = "auto"
     # Dropout-key PRNG: "rbg" rides the TPU hardware generator (profiled
     # ~1.5x step speedup over threefry on bert-large — threefry's bit
     # arithmetic competes with the matmuls for VPU cycles); "threefry2x32"

@@ -377,3 +377,32 @@ def test_chained_steps_match_per_step():
     a = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s1.params)])
     b = np.concatenate([np.ravel(x) for x in jax.tree.leaves(s2.params)])
     np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_unroll_accum_rolled_matches_unrolled():
+    """The rolled and unrolled accumulation scans are the same math —
+    forcing either via make_train_step(unroll_accum=...) must produce
+    identical losses and updated params (the knob exists purely for the
+    peak-memory difference, NOTES.md round-4)."""
+    batch = jax.tree.map(
+        jnp.asarray, make_batch(np.random.default_rng(11), 3, 8)
+    )
+    outs = {}
+    for name, unroll in (("rolled", False), ("unrolled", True)):
+        s = tiny_state()
+        step = make_train_step(
+            grad_accum_steps=3, log_grad_norm=False, unroll_accum=unroll
+        )
+        s2, m = step(s, batch)
+        outs[name] = (
+            float(m["loss"]),
+            np.concatenate(
+                [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(s2.params)]
+            ),
+        )
+    np.testing.assert_allclose(
+        outs["rolled"][0], outs["unrolled"][0], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        outs["rolled"][1], outs["unrolled"][1], atol=1e-6
+    )
