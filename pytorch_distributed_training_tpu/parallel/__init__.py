@@ -3,5 +3,19 @@ from pytorch_distributed_training_tpu.parallel.sharding import (
     state_shardings,
     param_pspecs,
 )
+from pytorch_distributed_training_tpu.parallel.pipeline import (
+    GPipeClassifier,
+    gpipe_apply,
+    make_1f1b_train_step,
+    one_f_one_b_grads,
+)
 
-__all__ = ["ShardingPolicy", "state_shardings", "param_pspecs"]
+__all__ = [
+    "ShardingPolicy",
+    "state_shardings",
+    "param_pspecs",
+    "GPipeClassifier",
+    "gpipe_apply",
+    "make_1f1b_train_step",
+    "one_f_one_b_grads",
+]

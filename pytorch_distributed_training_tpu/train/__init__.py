@@ -3,7 +3,11 @@ from pytorch_distributed_training_tpu.train.optim import (
     linear_warmup_schedule,
 )
 from pytorch_distributed_training_tpu.train.state import TrainState, create_train_state
-from pytorch_distributed_training_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_training_tpu.train.step import (
+    calibrate_quant,
+    make_eval_step,
+    make_train_step,
+)
 from pytorch_distributed_training_tpu.train.metrics import MetricAccumulator
 
 __all__ = [
@@ -13,5 +17,6 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "calibrate_quant",
     "MetricAccumulator",
 ]

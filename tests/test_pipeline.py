@@ -487,3 +487,26 @@ def test_1f1b_step_matches_standard_step_at_dropout0(eight_devices):
     np.testing.assert_allclose(
         results["std"][1], results["1f1b"][1], atol=3e-5
     )
+
+
+def test_pipeline_rejects_unsupported_configs(eight_devices):
+    """Clear ValueErrors for the combos the pipeline trunks cannot run
+    (raw-function layer application: no flax quant collection; 1F1B needs
+    the stacked layer dim) — instead of deep flax/KeyError failures."""
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        GPipeClassifier,
+        make_1f1b_train_step,
+    )
+
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    qcfg = model_preset(
+        "tiny", scan_layers=True, matmul_impl="int8_full", quant_delayed=True
+    )
+    with pytest.raises(ValueError, match="quant_delayed"):
+        GPipeClassifier(qcfg, mesh, n_micro=2)
+    with pytest.raises(ValueError, match="quant_delayed"):
+        make_1f1b_train_step(qcfg, mesh, None, n_micro=2, grad_accum_steps=1)
+    with pytest.raises(ValueError, match="scan_layers"):
+        make_1f1b_train_step(
+            model_preset("tiny"), mesh, None, n_micro=2, grad_accum_steps=1
+        )
