@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("nothing", "dots", "weight_dots"),
                    help="what remat saves: nothing = full recompute; dots = "
                         "save matmul outputs, recompute the elementwise tail")
+    p.add_argument("--remat-mlp", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="rematerialize ONLY each block's MLP tail "
+                        "(structural jax.checkpoint — drops the gelu "
+                        "residuals without full-layer recompute; pair with "
+                        "--unroll-accum off for the lowest peak memory)")
     p.add_argument("--mesh-data", type=int, default=1)
     p.add_argument("--mesh-fsdp", type=int, default=-1)
     p.add_argument("--mesh-model", type=int, default=1)
@@ -69,6 +75,7 @@ def main(argv=None) -> list[dict]:
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         scan_layers=args.scan_layers,
         remat=args.remat, remat_policy=args.remat_policy,
+        remat_mlp=args.remat_mlp,
         matmul_impl=args.matmul_impl,
         **resolve_attention(args.attention, args.mesh_seq),
     )

@@ -196,6 +196,18 @@ class ModelConfig:
                 f"remat=True",
                 stacklevel=2,
             )
+        if self.remat_mlp and self.remat:
+            import warnings
+
+            # full-layer remat already recomputes the MLP; nesting a second
+            # checkpoint inside it recomputes the MLP TWICE in the backward
+            # for zero extra memory savings
+            warnings.warn(
+                "remat_mlp=True is redundant under remat=True (the layer "
+                "checkpoint already recomputes the MLP); the nested "
+                "checkpoint only adds recompute",
+                stacklevel=2,
+            )
 
     @property
     def head_dim(self) -> int:
