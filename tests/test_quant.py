@@ -274,3 +274,33 @@ def test_quant_state_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(m_a["loss"]), np.asarray(m_b["loss"])
     )
+
+
+@pytest.mark.slow
+def test_trainer_resume_keeps_checkpointed_quant_scales(eight_devices, tmp_path):
+    """A resumed delayed-quant run restores the checkpoint's amaxes and
+    skips re-calibration (the trajectory depends on the carried scales —
+    re-observing them would fork it; also saves a wasted forward compile)."""
+    from pytorch_distributed_training_tpu.train.loop import Trainer
+
+    def trainer(resume):
+        mcfg = model_preset(
+            "tiny", compute_dtype="float32",
+            matmul_impl="int8_full", quant_delayed=True,
+        )
+        tcfg = TrainConfig(
+            num_epochs=1, global_batch_size=16, micro_batch_size=8,
+            eval_batch_size=16, train_size=32, eval_size=16,
+            max_seq_length=16, bf16=False, log_every=0,
+            checkpoint_dir=str(tmp_path / "ck"), resume=resume,
+        )
+        return Trainer(mcfg, tcfg, MeshConfig(data=8), ShardingPolicy(),
+                       task="synthetic")
+
+    t1 = trainer(resume=False)
+    t1.run()
+    saved = jax.tree.map(float, jax.device_get(t1.state.quant))
+
+    t2 = trainer(resume=True)  # restores the epoch-end checkpoint
+    restored = jax.tree.map(float, jax.device_get(t2.state.quant))
+    assert saved == restored  # not re-calibrated from the first batch
