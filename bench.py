@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 
@@ -60,6 +61,102 @@ MODEL_BASELINES = {
         "precision": "fp16 AMP (A100)",
     },
 }
+
+
+_PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform, len(d), flush=True)"
+)
+
+
+def probe_backend(budget_s: float = 600.0, poll_s: float = 5.0) -> dict:
+    """Bounded probe of the JAX backend in a THROWAWAY subprocess.
+
+    The axon tunnel's chip claim can be transiently wedged server-side
+    (NOTES.md pitfalls): a first ``jax.devices()`` then either raises
+    ``UNAVAILABLE`` or hangs past any useful deadline. Neither failure is
+    recoverable in-process (a hung PJRT init can't be preempted), so the
+    first backend touch happens in a subprocess, and only after a clean
+    probe does this process initialize the backend for real.
+
+    The probe child is NEVER killed — not at the deadline, not ever:
+    SIGKILLing a client whose chip claim is in flight is exactly what
+    wedges the tunnel for hours (NOTES.md "never kill a TPU-attached
+    process"). A child still hanging when the budget runs out is left to
+    finish on its own (it prints and exits cleanly whenever init finally
+    completes or errors, releasing any claim). Fast failures
+    (UNAVAILABLE) respawn after a 15/30/60s… backoff so a lease expiring
+    mid-probe is caught without hammering the relay.
+
+    Returns {"ok": True, "platform": ..., "n_devices": ...} or
+    {"ok": False, "cause": ..., "attempts": [...per-try records...]}.
+    """
+    import tempfile
+
+    history = []
+    deadline = time.monotonic() + budget_s
+    backoff = 15.0
+    child = None
+    started = 0.0
+    while time.monotonic() < deadline:
+        if child is None:
+            # child output goes to temp FILES, not pipes: the parent only
+            # wait()s, and a chatty runtime (UNAVAILABLE retry spew) would
+            # fill a 64KB pipe and block the child in write() forever
+            out_f = tempfile.TemporaryFile(mode="w+")
+            err_f = tempfile.TemporaryFile(mode="w+")
+            child = subprocess.Popen(
+                [sys.executable, "-c", _PROBE_SRC],
+                stdout=out_f, stderr=err_f, text=True,
+            )
+            started = time.monotonic()
+        try:
+            rc = child.wait(
+                timeout=min(poll_s, max(deadline - time.monotonic(), 0.1))
+            )
+        except subprocess.TimeoutExpired:
+            continue  # still initializing; keep waiting, never kill
+        out_f.seek(0)
+        out = out_f.read()
+        err_f.seek(0)
+        err = err_f.read()
+        out_f.close()
+        err_f.close()
+        elapsed = round(time.monotonic() - started, 1)
+        toks = out.split()
+        if rc == 0 and len(toks) >= 2 and toks[-1].isdigit():
+            # parse the LAST two tokens: plugin/runtime banners may
+            # precede the probe's own print on stdout
+            return {"ok": True, "platform": toks[-2],
+                    "n_devices": int(toks[-1]), "probe_seconds": elapsed,
+                    "failed_attempts": history}
+        history.append({
+            "outcome": f"rc={rc}", "seconds": elapsed,
+            "stdout_tail": out.strip()[-200:],
+            "stderr_tail": err.strip()[-400:],
+        })
+        child = None
+        time.sleep(min(backoff, max(deadline - time.monotonic(), 0)))
+        backoff = min(backoff * 2, 120.0)
+    if child is not None and child.poll() is None:
+        return {
+            "ok": False,
+            "cause": (
+                "backend init still hung when the probe budget ran out "
+                "(axon tunnel chip claim likely wedged server-side); the "
+                "probe child was left running — killing a mid-claim "
+                "client is what wedges the tunnel — and will exit on its "
+                "own when init completes or errors"
+            ),
+            "hung_child_pid": child.pid,
+            "hung_for_s": round(time.monotonic() - started, 1),
+            "attempts": history,
+        }
+    return {
+        "ok": False,
+        "cause": "backend init failed every try; see attempts[].stderr_tail",
+        "attempts": history,
+    }
 
 
 def run_bench(
@@ -343,7 +440,25 @@ def main(argv=None):
                         "serialization (ops/quant.py). Default: on for "
                         "int8 impls (multi-seed convergence-gated), "
                         "meaningless otherwise")
+    p.add_argument("--probe-budget-s", type=float, default=600.0,
+                   help="total budget (s) for the subprocess backend probe "
+                        "before declaring the tunnel down (0 = skip probe)")
     args = p.parse_args(argv)
+    if args.probe_budget_s > 0:
+        probe = probe_backend(args.probe_budget_s)
+        if not probe["ok"]:
+            # Structured failure: one JSON line naming the cause, so a
+            # transiently wedged tunnel yields a diagnosable artifact
+            # instead of a bare rc=1 (round-4 lost its verification to
+            # exactly that).
+            print(json.dumps({
+                "metric": "benchmark not run: JAX backend unavailable",
+                "value": None,
+                "unit": "samples/sec/chip",
+                "vs_baseline": None,
+                "error": probe,
+            }))
+            return None
     result = run_bench(
         model_name=args.model,
         global_batch=args.global_batch_size,

@@ -50,6 +50,19 @@ def _saveable(state: TrainState) -> dict:
     return d
 
 
+def _saved_top_keys(mngr, step: int):
+    """Top-level keys of the saved tree, read from checkpoint METADATA only
+    (no tensor bytes); None when the metadata shape is unrecognized."""
+    try:
+        meta = mngr.item_metadata(step)
+        tree = getattr(meta, "tree", meta)
+        if isinstance(tree, dict):
+            return set(tree.keys())
+    except Exception:
+        pass
+    return None
+
+
 def _restore_standard(mngr, step: int, state: TrainState) -> dict:
     """StandardRestore into ``state``'s abstract tree, with a clear message
     for the one structural mismatch a user can cause: the ``quant`` subtree
@@ -59,10 +72,12 @@ def _restore_standard(mngr, step: int, state: TrainState) -> dict:
     try:
         return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
     except Exception as e:
-        # relabel ONLY the structure mismatch this flag can cause (the
-        # orbax error names the offending subtree); anything else — torn
-        # writes, dtype/sharding mismatches — propagates untouched
-        if "quant" not in str(e):
+        # relabel ONLY the structural mismatch this flag can cause —
+        # verified against the saved tree's metadata, not the error text
+        # (a dtype/sharding error on the quant leaf itself must propagate
+        # untouched, and its message also says "quant")
+        saved = _saved_top_keys(mngr, step)
+        if saved is None or ("quant" in saved) == ("quant" in abstract):
             raise
         on = state.quant is not None
         raise ValueError(

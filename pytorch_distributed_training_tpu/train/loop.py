@@ -209,16 +209,25 @@ class Trainer:
             )
             from jax.sharding import PartitionSpec as P
 
-            micro = (
-                train_config.global_batch_size
-                // train_config.grad_accum_steps
+            from pytorch_distributed_training_tpu.data.pipeline import (
+                resolve_batch_geometry,
             )
-            rows = {
-                k: np.asarray(v)[
-                    np.arange(micro) % len(v)  # wrap tiny datasets
-                ]
-                for k, v in train_data.items()
-            }
+
+            # per-host slice of the first global microbatch (the same
+            # contract both loaders use) — so the calibration forward runs
+            # at exactly the training microbatch geometry: no duplicated
+            # rows across hosts, no extra compile at a different shape
+            pidx, _, micro_global, micro_local, _ = resolve_batch_geometry(
+                self.mesh,
+                global_batch_size=train_config.global_batch_size,
+                grad_accum_steps=train_config.grad_accum_steps,
+                train=True,
+            )
+            take = np.arange(micro_global) % len(
+                next(iter(train_data.values()))
+            )  # wrap tiny datasets
+            local = take[pidx * micro_local : (pidx + 1) * micro_local]
+            rows = {k: np.asarray(v)[local] for k, v in train_data.items()}
             micro0 = make_global_batch(self.mesh, rows, pspec=P(BATCH_AXES))
             self.state = calibrate_quant(self.state, micro0)
 

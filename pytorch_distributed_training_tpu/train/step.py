@@ -171,9 +171,11 @@ def make_train_step(
     extra leading [chain_steps] dim: ONE dispatch executes that many
     optimizer steps back-to-back on device (lax.scan over the per-step
     body). Host dispatch latency — a few ms per call through remote/tunnel
-    runtimes — amortizes across the chain; metrics come back for the LAST
-    step only (per-step metrics would force device->host syncs, defeating
-    the point). The per-step numerics are identical to chain_steps=1.
+    runtimes — amortizes across the chain; ``loss`` comes back as the MEAN
+    over the chain (so epoch averages weight every step equally, matching
+    chain_steps=1 artifacts) while other metrics report the LAST step
+    (per-step metrics would force device->host syncs, defeating the
+    point). The per-step numerics are identical to chain_steps=1.
     """
 
     forward_loss = _LOSS_FNS[objective]
@@ -262,7 +264,11 @@ def make_train_step(
             # scan carries the metrics DICT as a pytree — no parallel key
             # list to keep in sync with whatever single_step emits
             state, stacked = jax.lax.scan(single_step, state, batches)
-            return state, {k: v[-1] for k, v in stacked.items()}
+            out = {k: v[-1] for k, v in stacked.items()}
+            # chain-mean loss: an epoch average built from these then
+            # weights every optimizer step equally, not just chain tails
+            out["loss"] = stacked["loss"].mean()
+            return state, out
 
     donate = (0,)
     if mesh is None:

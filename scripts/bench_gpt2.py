@@ -92,9 +92,22 @@ def run(micro=4, block_q=None, block_k=None, unroll=None, **mkw):
     )
 
 
+def parse_combo(spec):
+    """``micro=8,remat_mlp=True,block_q=512`` -> kwargs dict (literals only)."""
+    import ast
+
+    out = {}
+    for part in spec.split(","):
+        key, _, val = part.partition("=")
+        if not _:
+            raise SystemExit(f"combo item {part!r} is not key=value")
+        out[key.strip()] = ast.literal_eval(val.strip())
+    return out
+
+
 if __name__ == "__main__":
-    # combos picked per round; pass python-literal dicts as argv to
-    # override, e.g. scripts/bench_gpt2.py "dict(micro=8, remat_mlp=True)"
+    # combos picked per round; pass key=value lists as argv to override,
+    # e.g. scripts/bench_gpt2.py "micro=8,remat_mlp=True"
     default = (
         dict(micro=4),
         dict(micro=6, remat_mlp=True),
@@ -103,7 +116,7 @@ if __name__ == "__main__":
         dict(micro=16, remat_mlp=True),
     )
     combos = (
-        [eval(a, {"dict": dict}) for a in sys.argv[1:]]  # noqa: S307
+        [parse_combo(a) for a in sys.argv[1:]]
         if len(sys.argv) > 1
         else default
     )

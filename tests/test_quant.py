@@ -277,6 +277,27 @@ def test_quant_state_checkpoint_roundtrip(tmp_path):
 
 
 @pytest.mark.slow
+def test_quant_flag_mismatch_restore_message(tmp_path):
+    """Saving WITHOUT delayed quant and resuming WITH it is a structural
+    tree mismatch (the 'quant' subtree exists iff the saving run had the
+    flag on); restore must relabel it with the flag name — detected from
+    the checkpoint's metadata, not the error text (ADVICE r4)."""
+    from pytorch_distributed_training_tpu.train import checkpoint as ckpt
+
+    rng = np.random.default_rng(6)
+    batch = jax.tree.map(jnp.asarray, make_batch(rng, 2, 4))
+    s = quant_state(delayed=False)
+    step = make_train_step(grad_accum_steps=2, log_grad_norm=False)
+    s, _ = step(s, batch)
+    ckpt.save_checkpoint(str(tmp_path / "q"), s)
+
+    fresh = quant_state(delayed=True)
+    fresh = calibrate_quant(fresh, jax.tree.map(lambda x: x[0], batch))
+    with pytest.raises(ValueError, match="--quant-delayed"):
+        ckpt.restore_checkpoint(str(tmp_path / "q"), fresh)
+
+
+@pytest.mark.slow
 def test_trainer_resume_keeps_checkpointed_quant_scales(eight_devices, tmp_path):
     """A resumed delayed-quant run restores the checkpoint's amaxes and
     skips re-calibration (the trajectory depends on the carried scales —
