@@ -63,6 +63,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "count that divides the micro-batch size with "
                         "per-microbatch batches divisible over data*fsdp)")
     p.add_argument("--attention", default=None)
+    p.add_argument("--matmul-impl", default="native",
+                   choices=("native", "int8", "int8_full"),
+                   help="dense-matmul path (ops/quant.py): int8 runs the "
+                        "MXU's 2x-rate int8 tier with dynamic quantization")
+    p.add_argument("--quant-delayed", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="delayed (previous-microbatch) int8 activation "
+                        "scaling; under the pipeline/1f1b schedules the "
+                        "amaxes stream through the tick carry "
+                        "(parallel/pipeline.py)")
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--mesh-data", type=int, default=-1)
     p.add_argument("--mesh-fsdp", type=int, default=1)
@@ -79,10 +89,16 @@ def main(argv=None) -> list[dict]:
     tcfg = dataclass_from_args(TrainConfig, args)
     from pytorch_distributed_training_tpu.cli import resolve_attention
 
+    if args.quant_delayed and args.matmul_impl == "native":
+        raise SystemExit(
+            "--quant-delayed requires --matmul-impl int8|int8_full"
+        )
     mcfg = model_preset(
         args.model,
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         scan_layers=args.mp_mode in ("stage", "pipeline", "1f1b"),
+        matmul_impl=args.matmul_impl,
+        quant_delayed=args.quant_delayed,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(

@@ -34,6 +34,16 @@ the schedule each stage folds in its stage index and each layer its local
 layer index, so every (microbatch, layer) dropout site draws from a
 distinct stream — and because the keys are a pure function of the primal
 inputs, ``jax.grad``/remat regenerate bit-identical masks in the backward.
+
+Delayed-int8 amax streaming (``stacked_quant``): the flax "quant"
+collection's [num_layers]-leading amaxes shard over the stage axis like
+the params, and each stage carries its slice across ticks — every
+pipeline microbatch quantizes with the previous one's observations at
+that site, the schedule-level twin of the standard step's accumulation
+carry (train/step.py). 1F1B additionally stashes the scales each forward
+tick used so its backward recompute quantizes identically; with a
+data-sharded stream, in-flight scales are shard-local (tighter) and the
+carried-out amax is the cross-shard max.
 ``GPipeClassifier`` packages the whole thing as an init/apply-compatible
 stand-in for ``BertForSequenceClassification(scan_layers=True)``: same
 parameter tree (checkpoints and ``ShardingPolicy(stage=True)`` shardings
@@ -68,6 +78,7 @@ def gpipe_apply(
     stream_spec: P | None = None,
     mb_keys=None,
     rng_impl=None,
+    stacked_quant=None,
 ):
     """Run ``layer_fn`` stacked-layer trunk over microbatches, pipelined.
 
@@ -76,7 +87,9 @@ def gpipe_apply(
         layer_fn: ``(layer_params, x, bias) -> x`` for ONE layer, where
             ``layer_params`` is one slice of ``stacked_params`` minus the
             leading layer dim. With ``mb_keys`` given, the signature is
-            ``(layer_params, x, bias, rng) -> x`` instead.
+            ``(layer_params, x, bias, rng) -> x`` instead. With
+            ``stacked_quant`` given, the per-layer quant subtree is the
+            LAST argument and the return is ``(x, new_quant_layer)``.
         stacked_params: pytree with leading [num_layers] dim on every
             leaf; num_layers must divide by the stage count.
         microbatches: [n_micro, mb, ...] activations entering layer 0.
@@ -94,10 +107,19 @@ def gpipe_apply(
             backward regenerates exactly (keys are primal-deterministic).
         rng_impl: the key impl (``jax.random.key_impl`` of the source
             key) — required with ``mb_keys`` to rewrap the raw key data.
+        stacked_quant: optional delayed-int8 amax collection with the same
+            leading [num_layers] dim (ops/quant.py). Sharded over the
+            stage axis like the params; each stage carries its slice
+            across ticks, so every pipeline microbatch quantizes with the
+            amaxes the PREVIOUS microbatch observed at that site — the
+            schedule-level generalization of the standard step's
+            accumulation-scan carry. Fill/drain ticks (garbage inputs)
+            mask their updates.
 
     Returns:
         [n_micro, mb, ...] activations after the last layer — identical
         (up to float reassociation) to running the layers sequentially.
+        With ``stacked_quant``: ``(activations, new_stacked_quant)``.
     """
     n_stages = mesh.shape[axis]
     n_micro = microbatches.shape[0]
@@ -113,6 +135,7 @@ def gpipe_apply(
         )
     if mb_keys is not None and rng_impl is None:
         raise ValueError("mb_keys requires rng_impl (jax.random.key_impl)")
+    has_quant = stacked_quant is not None
 
     # mesh axes the microbatch stream is sharded over (for per-shard
     # dropout-key folding inside the manual region)
@@ -123,37 +146,25 @@ def gpipe_apply(
                 continue
             shard_axes += entry if isinstance(entry, tuple) else (entry,)
 
-    def local_block(params_local, x, b, key=None):
-        if key is None:
+    local_block = _make_local_block(layer_fn, num_layers // n_stages)
 
-            def body(h, lp):
-                return layer_fn(lp, h, b), None
-
-            out, _ = jax.lax.scan(body, x, params_local)
-        else:
-            layer_idx = jnp.arange(num_layers // n_stages, dtype=jnp.int32)
-
-            def body(h, lp_i):
-                lp, li = lp_i
-                return layer_fn(lp, h, b, jax.random.fold_in(key, li)), None
-
-            out, _ = jax.lax.scan(body, x, (params_local, layer_idx))
-        return out
-
-    def inner(params_local, xs, biases, *maybe_keys):
+    def inner(params_local, xs, biases, *rest):
         # params_local: [L/S, ...]; xs/biases carry the FULL microbatch
         # stream on every stage (replicated) — only stage 0 reads xs.
         from pytorch_distributed_training_tpu.ops import dispatch
 
         with dispatch.manual_region():
-            return _inner_body(params_local, xs, biases, *maybe_keys)
+            return _inner_body(params_local, xs, biases, *rest)
 
-    def _inner_body(params_local, xs, biases, *maybe_keys):
+    def _inner_body(params_local, xs, biases, *rest):
+        rest = list(rest)
+        keys = rest.pop(0) if mb_keys is not None else None
+        q0 = rest.pop(0) if has_quant else None
         stage = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            buf, outs = carry
+            buf, outs, q = carry
             mb_in = jax.lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
             )
@@ -163,9 +174,9 @@ def gpipe_apply(
                 biases, b_idx, axis=0, keepdims=False
             )
             key = None
-            if maybe_keys:
+            if keys is not None:
                 kd = jax.lax.dynamic_index_in_dim(
-                    maybe_keys[0], b_idx, axis=0, keepdims=False
+                    keys, b_idx, axis=0, keepdims=False
                 )
                 key = jax.random.fold_in(
                     jax.random.wrap_key_data(kd, impl=rng_impl), stage
@@ -179,7 +190,23 @@ def gpipe_apply(
                     key = jax.random.fold_in(
                         key, dispatch.linear_device_index(shard_axes, mesh)
                     )
-            y = local_block(params_local, x, b, key)
+            y, new_q = local_block(params_local, x, b, key, q)
+            if has_quant:
+                # this stage computed microbatch f = t - stage; amaxes
+                # observed on fill/drain garbage must not leak forward.
+                # stop_gradient: the amax chain is observation-only (the
+                # quantizer's custom vjp zeroes its cotangent anyway), and
+                # GPipe's jax.grad backward must not be asked to
+                # differentiate the carry — or transpose the cross-shard
+                # pmax below, which has no AD rule.
+                f_act = jnp.logical_and(t - stage >= 0, t - stage < n_micro)
+                q = jax.tree.map(
+                    lambda old, new: jnp.where(
+                        f_act, jax.lax.stop_gradient(new), old
+                    ),
+                    q,
+                    new_q,
+                )
             # last stage finished microbatch t - (n_stages - 1)
             out_t = t - (n_stages - 1)
             write = jnp.logical_and(
@@ -196,17 +223,27 @@ def gpipe_apply(
                 0,
             )
             buf = jax.lax.ppermute(y, axis, perm)
-            return (buf, outs), None
+            return (buf, outs, q), None
 
         buf0 = jnp.zeros_like(xs[0])
         outs0 = jnp.zeros_like(xs)
-        (_, outs), _ = jax.lax.scan(
+        (_, outs, q_out), _ = jax.lax.scan(
             tick,
-            (buf0, outs0),
+            (buf0, outs0, q0),
             jnp.arange(n_micro + n_stages - 1, dtype=jnp.int32),
         )
         # only the LAST stage's outs buffer is real; expose a leading
         # per-stage dim so the caller can select it.
+        if has_quant:
+            if shard_axes:
+                # with a data-sharded stream each shard observed its own
+                # rows' absmax (tighter scales in-flight); the CARRIED-OUT
+                # amax must cover the whole microbatch — max across shards
+                # (the out-spec would otherwise keep one shard's copy)
+                q_out = jax.tree.map(
+                    lambda a: jax.lax.pmax(a, shard_axes), q_out
+                )
+            return outs[None], q_out
         return outs[None]
 
     stream = stream_spec if stream_spec is not None else P()
@@ -216,14 +253,74 @@ def gpipe_apply(
     if mb_keys is not None:
         in_specs.append(P())  # keys are tiny; replicate to every stage
         args.append(mb_keys)
+    out_specs = P(axis, *stream)
+    if has_quant:
+        in_specs.append(jax.tree.map(lambda _: P(axis), stacked_quant))
+        args.append(stacked_quant)
+        out_specs = (
+            out_specs,
+            jax.tree.map(lambda _: P(axis), stacked_quant),
+        )
     out = shard_map(
         inner,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(axis, *stream),
+        out_specs=out_specs,
         check_rep=False,
     )(*args)
+    if has_quant:
+        return out[0][-1], out[1]
     return out[-1]
+
+
+def _make_local_block(layer_fn: Callable, layers_per_stage: int):
+    """One stage's layer scan, shared by both schedules.
+
+    ``layer_fn`` arity follows the caller's configuration: a trailing rng
+    argument when dropout keys stream, a trailing per-layer quant subtree
+    (returned updated as ``(x, new_quant)``) when delayed int8 threads.
+    Returns ``(out, new_quant_or_None)``.
+    """
+
+    def local_block(params_local, x, b, key=None, q_local=None):
+        layer_idx = jnp.arange(layers_per_stage, dtype=jnp.int32)
+        if q_local is None:
+            if key is None:
+
+                def body(h, lp):
+                    return layer_fn(lp, h, b), None
+
+                out, _ = jax.lax.scan(body, x, params_local)
+            else:
+
+                def body(h, lp_i):
+                    lp, li = lp_i
+                    return (
+                        layer_fn(lp, h, b, jax.random.fold_in(key, li)),
+                        None,
+                    )
+
+                out, _ = jax.lax.scan(body, x, (params_local, layer_idx))
+            return out, None
+        if key is None:
+
+            def body(h, lp_q):
+                lp, ql = lp_q
+                return layer_fn(lp, h, b, ql)  # -> (h', new_ql)
+
+            out, new_q = jax.lax.scan(body, x, (params_local, q_local))
+        else:
+
+            def body(h, lp_q_i):
+                lp, ql, li = lp_q_i
+                return layer_fn(lp, h, b, jax.random.fold_in(key, li), ql)
+
+            out, new_q = jax.lax.scan(
+                body, x, (params_local, q_local, layer_idx)
+            )
+        return out, new_q
+
+    return local_block
 
 
 def one_f_one_b_grads(
@@ -240,6 +337,7 @@ def one_f_one_b_grads(
     stream_spec: P | None = None,
     mb_keys=None,
     rng_impl=None,
+    stacked_quant=None,
 ):
     """1F1B-scheduled pipeline TRAINING pass → (loss, grads, input cotangents).
 
@@ -268,12 +366,20 @@ def one_f_one_b_grads(
             form OUTSIDE shard_map where GSPMD inserts the reductions).
         head_params: its param pytree (replicated to every stage).
         labels: [n_micro, mb] integer labels streamed with the batch.
+        stacked_quant: optional delayed-int8 amax collection ([L]-leading,
+            ops/quant.py), threaded as in :func:`gpipe_apply` — PLUS a
+            per-slot stash of the scales each forward tick actually used,
+            so the backward tick's block recompute quantizes with the
+            exact same scales (the carry has advanced by up to
+            ``2(S-1)`` ticks in between). ``layer_fn`` then takes the
+            per-layer quant subtree last and returns ``(x, new_quant)``.
 
     Returns:
         (loss_sum, trunk_grads [L, ...], head_grads, d_xs [n_micro, ...])
         — ``d_xs`` are the cotangents at the trunk input, for the caller
         to feed the embedding backward (embeddings live outside the
-        pipeline, as in the reference's ConcatBert split).
+        pipeline, as in the reference's ConcatBert split). With
+        ``stacked_quant``, a fifth element: the updated [L] amaxes.
 
     The schedule (stage s, tick t; S = n_stages):
         forward of microbatch f = t - s;   backward of b = t - 2(S-1) + s.
@@ -297,6 +403,7 @@ def one_f_one_b_grads(
     if mb_keys is not None and rng_impl is None:
         raise ValueError("mb_keys requires rng_impl (jax.random.key_impl)")
     stash_size = 2 * n_stages  # max residual lifetime is 2(S-1) ticks
+    has_quant = stacked_quant is not None
 
     shard_axes: tuple = ()
     if stream_spec is not None:
@@ -306,43 +413,30 @@ def one_f_one_b_grads(
             shard_axes += entry if isinstance(entry, tuple) else (entry,)
 
     layers_per_stage = num_layers // n_stages
+    local_block = _make_local_block(layer_fn, layers_per_stage)
 
-    def local_block(params_local, x, b, key=None):
-        if key is None:
-
-            def body(h, lp):
-                return layer_fn(lp, h, b), None
-
-            out, _ = jax.lax.scan(body, x, params_local)
-        else:
-            layer_idx = jnp.arange(layers_per_stage, dtype=jnp.int32)
-
-            def body(h, lp_i):
-                lp, li = lp_i
-                return layer_fn(lp, h, b, jax.random.fold_in(key, li)), None
-
-            out, _ = jax.lax.scan(body, x, (params_local, layer_idx))
-        return out
-
-    def inner(params_local, head_p, xs_, biases_, labels_, *maybe_keys):
+    def inner(params_local, head_p, xs_, biases_, labels_, *rest):
         from pytorch_distributed_training_tpu.ops import dispatch
 
         with dispatch.manual_region():
             return _inner_body(
-                params_local, head_p, xs_, biases_, labels_, *maybe_keys
+                params_local, head_p, xs_, biases_, labels_, *rest
             )
 
-    def _inner_body(params_local, head_p, xs_, biases_, labels_, *maybe_keys):
+    def _inner_body(params_local, head_p, xs_, biases_, labels_, *rest):
+        rest = list(rest)
+        keys = rest.pop(0) if mb_keys is not None else None
+        q0 = rest.pop(0) if has_quant else None
         stage = jax.lax.axis_index(axis)
         last = n_stages - 1
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
         def derive_key(mb_idx):
-            if not maybe_keys:
+            if keys is None:
                 return None
             kd = jax.lax.dynamic_index_in_dim(
-                maybe_keys[0], mb_idx, axis=0, keepdims=False
+                keys, mb_idx, axis=0, keepdims=False
             )
             key = jax.random.fold_in(
                 jax.random.wrap_key_data(kd, impl=rng_impl), stage
@@ -362,7 +456,7 @@ def one_f_one_b_grads(
             )
 
         def tick(carry, t):
-            fbuf, bbuf, stash, tg, hg, loss_sum, dxs = carry
+            fbuf, bbuf, stash, stash_q, tg, hg, loss_sum, dxs, q = carry
 
             # ---------------- forward of microbatch f = t - stage
             mb_f = t - stage
@@ -377,7 +471,7 @@ def one_f_one_b_grads(
                 biases_, mb_f_c, 0, keepdims=False
             )
             key_f = derive_key(mb_f_c)
-            y = local_block(params_local, x_in, b_f, key_f)
+            y, new_q = local_block(params_local, x_in, b_f, key_f, q)
             # stash the block INPUT (internals recompute in the B tick)
             slot_f = mb_f_c % stash_size
             prev_slot = jax.lax.dynamic_index_in_dim(
@@ -386,6 +480,22 @@ def one_f_one_b_grads(
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, jnp.where(f_act, x_in, prev_slot), slot_f, 0
             )
+            if has_quant:
+                # stash the PRE-update amaxes (the scales this forward
+                # actually quantized with) for the backward recompute,
+                # then advance the carry with the fresh observations
+                def _stash_q(sq, qv):
+                    prev = jax.lax.dynamic_index_in_dim(
+                        sq, slot_f, 0, keepdims=False
+                    )
+                    return jax.lax.dynamic_update_index_in_dim(
+                        sq, jnp.where(f_act, qv, prev), slot_f, 0
+                    )
+
+                stash_q = jax.tree.map(_stash_q, stash_q, q)
+                q = jax.tree.map(
+                    lambda old, new: jnp.where(f_act, new, old), q, new_q
+                )
 
             # last stage: head F+B for mb_f right now (bridges F into B)
             lab_f = jax.lax.dynamic_index_in_dim(
@@ -417,9 +527,19 @@ def one_f_one_b_grads(
             )
             key_b = derive_key(mb_b_c)
             g_in = jnp.where(stage == last, dy, bbuf).astype(y.dtype)
+            q_b = (
+                jax.tree.map(
+                    lambda sq: jax.lax.dynamic_index_in_dim(
+                        sq, slot_b, 0, keepdims=False
+                    ),
+                    stash_q,
+                )
+                if has_quant
+                else None
+            )
 
             def block_f(p, x):
-                return local_block(p, x, b_b, key_b)
+                return local_block(p, x, b_b, key_b, q_b)[0]
 
             _, block_vjp = jax.vjp(block_f, params_local, x_b)
             dp, dx = block_vjp(g_in)
@@ -439,13 +559,18 @@ def one_f_one_b_grads(
 
             fbuf = jax.lax.ppermute(y, axis, fwd_perm)
             bbuf = jax.lax.ppermute(dx, axis, bwd_perm)
-            return (fbuf, bbuf, stash, tg, hg, loss_sum, dxs), None
+            return (
+                fbuf, bbuf, stash, stash_q, tg, hg, loss_sum, dxs, q
+            ), None
 
         zero_x = jnp.zeros_like(xs_[0])
         carry0 = (
             zero_x,  # fwd hop buffer
             zero_x,  # bwd hop buffer (cotangents share x's shape)
             jnp.zeros((stash_size, *zero_x.shape), zero_x.dtype),
+            jax.tree.map(
+                lambda l: jnp.zeros((stash_size, *l.shape), l.dtype), q0
+            ),  # per-slot amax stash (None -> empty pytree without quant)
             jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params_local
             ),
@@ -454,26 +579,37 @@ def one_f_one_b_grads(
             ),
             jnp.zeros((), jnp.float32),
             jnp.zeros(xs_.shape, xs_.dtype),
+            q0,
         )
         n_ticks = n_micro + 2 * (n_stages - 1)
-        (_, _, _, tg, hg, loss_sum, dxs), _ = jax.lax.scan(
+        (_, _, _, _, tg, hg, loss_sum, dxs, q_out), _ = jax.lax.scan(
             tick, carry0, jnp.arange(n_ticks, dtype=jnp.int32)
         )
         if shard_axes:
             # the stream is batch-sharded and the grads formed INSIDE this
             # manual region: sum the per-shard contributions (row-level
-            # outputs like dxs stay sharded)
+            # outputs like dxs stay sharded). Quant amaxes are NOT summed:
+            # every stream shard observed its own rows' absmax — take the
+            # max so the carried scale covers the whole microbatch, the
+            # same semantics as the unsharded absmax.
             tg = jax.lax.psum(tg, shard_axes)
             hg = jax.lax.psum(hg, shard_axes)
             loss_sum = jax.lax.psum(loss_sum, shard_axes)
+            if has_quant:
+                q_out = jax.tree.map(
+                    lambda a: jax.lax.pmax(a, shard_axes), q_out
+                )
         # per-stage results that are only real on ONE stage get a leading
         # stage dim; the caller selects (same trick as gpipe_apply's outs)
-        return (
+        out = (
             tg,
             jax.tree.map(lambda g: g[None], hg),
             loss_sum[None],
             dxs[None],
         )
+        if has_quant:
+            out = out + (q_out,)
+        return out
 
     stream = stream_spec if stream_spec is not None else P()
     stacked_spec = jax.tree.map(lambda _: P(axis), stacked_params)
@@ -484,38 +620,74 @@ def one_f_one_b_grads(
     if mb_keys is not None:
         in_specs.append(P())
         args.append(mb_keys)
-    tg, hg, loss, dxs = shard_map(
+    out_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        jax.tree.map(lambda _: P(axis), head_params),
+        P(axis),
+        P(axis, *stream),
+    )
+    if has_quant:
+        in_specs.append(jax.tree.map(lambda _: P(axis), stacked_quant))
+        args.append(stacked_quant)
+        out_specs = out_specs + (
+            jax.tree.map(lambda _: P(axis), stacked_quant),
+        )
+    res = shard_map(
         inner,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(
-            jax.tree.map(lambda _: P(axis), stacked_params),
-            jax.tree.map(lambda _: P(axis), head_params),
-            P(axis),
-            P(axis, *stream),
-        ),
+        out_specs=out_specs,
         check_rep=False,
     )(*args)
+    tg, hg, loss, dxs = res[:4]
     # head grads / loss are real on the LAST stage; dxs on stage 0
-    return (
+    out = (
         loss[-1],
         tg,
         jax.tree.map(lambda g: g[-1], hg),
         dxs[0],
     )
+    if has_quant:
+        out = out + (res[4],)
+    return out
 
 
-def gpipe_trunk_fn(cfg, *, with_dropout: bool = False):
+def gpipe_trunk_fn(cfg, *, with_dropout: bool = False,
+                   with_quant: bool = False):
     """``layer_fn`` for ``gpipe_apply`` from this framework's BertLayer —
     one post-LN encoder layer (models/bert.py). ``with_dropout`` switches
-    to the 4-arg rng signature (training mode: the streamed per-(tick,
-    stage, layer) key drives the layer's dropout sites). ``cfg.remat``
-    wraps the layer in jax.checkpoint (GPipe's memory trade)."""
+    to the rng signature (training mode: the streamed per-(tick, stage,
+    layer) key drives the layer's dropout sites); ``with_quant`` appends
+    the per-layer delayed-int8 amax subtree (ops/quant.py) as the last
+    argument and returns ``(x, new_quant)`` — the schedules thread it
+    through their tick carries. ``cfg.remat`` wraps the layer in
+    jax.checkpoint (GPipe's memory trade)."""
     from pytorch_distributed_training_tpu.models.bert import BertLayer
 
     layer = BertLayer(cfg)
 
-    if with_dropout:
+    if with_quant:
+
+        def q_apply(layer_params, x, bias, quant, rng):
+            y, mut = layer.apply(
+                {"params": layer_params, "quant": quant}, x, bias,
+                rng is None,
+                rngs={"dropout": rng} if rng is not None else None,
+                mutable=["quant"],
+            )
+            return y, mut["quant"]
+
+        if with_dropout:
+
+            def fn(layer_params, x, bias, rng, ql):
+                return q_apply(layer_params, x, bias, ql, rng)
+
+        else:
+
+            def fn(layer_params, x, bias, ql):
+                return q_apply(layer_params, x, bias, ql, None)
+
+    elif with_dropout:
 
         def fn(layer_params, x, bias, rng):
             return layer.apply(
@@ -581,13 +753,6 @@ def make_1f1b_train_step(
             "make_1f1b_train_step requires scan_layers=True (the schedule "
             "shards the stacked layer dim over the stage axis)"
         )
-    if getattr(cfg, "quant_delayed", False):
-        # same limitation as GPipeClassifier: the schedule applies layers
-        # as raw functions — no flax "quant" collection to thread
-        raise ValueError(
-            "quant_delayed is unsupported under the 1F1B pipeline; use "
-            "dynamic int8 (matmul_impl alone) or the serial trunk"
-        )
     n_stages = mesh.shape["stage"]
     emb = BertEmbeddings(cfg)
     pool = _PoolerHead(cfg)
@@ -595,7 +760,12 @@ def make_1f1b_train_step(
     acc_dtype = jnp.dtype(accum_dtype)
     bubble = 2 * (n_stages - 1) / (n_micro + 2 * (n_stages - 1))
     dropout_on = cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
-    layer_fn = gpipe_trunk_fn(cfg, with_dropout=dropout_on)
+    # delayed int8: the trunk amaxes stream through the schedule's tick
+    # carry (heads have no quant sites — plain nn.Dense, models/bert.py)
+    delayed = bool(getattr(cfg, "quant_delayed", False))
+    layer_fn = gpipe_trunk_fn(
+        cfg, with_dropout=dropout_on, with_quant=delayed
+    )
     stream_spec = P(None, tuple(batch_axes))
 
     def make_head_fn(mb_rows_global):
@@ -625,7 +795,7 @@ def make_1f1b_train_step(
         base_rng = jax.random.fold_in(state.dropout_rng, state.step)
 
         def micro_grads(carry, micro):
-            grads_acc, loss_acc = carry
+            grads_acc, loss_acc, quant = carry
             step_rng = jax.random.fold_in(
                 base_rng, loss_acc[1].astype(jnp.int32)
             )
@@ -663,7 +833,7 @@ def make_1f1b_train_step(
                 mb_keys = jax.random.key_data(keys)
                 rng_impl = jax.random.key_impl(pipe_rng)
 
-            loss, tg, hg, dxs = one_f_one_b_grads(
+            res = one_f_one_b_grads(
                 mesh, layer_fn, make_head_fn(mb),
                 params["bert"]["layers_scan"]["layer"],
                 {
@@ -673,7 +843,21 @@ def make_1f1b_train_step(
                 xs, biases, labels,
                 stream_spec=stream_spec,
                 mb_keys=mb_keys, rng_impl=rng_impl,
+                stacked_quant=(
+                    quant["bert"]["layers_scan"]["layer"]
+                    if delayed
+                    else None
+                ),
             )
+            loss, tg, hg, dxs = res[:4]
+            if delayed:
+                quant = {
+                    **quant,
+                    "bert": {
+                        **quant["bert"],
+                        "layers_scan": {"layer": res[4]},
+                    },
+                }
             (d_emb,) = emb_vjp(
                 dxs.reshape(batch_rows, *x.shape[1:]).astype(x.dtype)
             )
@@ -688,21 +872,26 @@ def make_1f1b_train_step(
             grads_acc = jax.tree.map(
                 lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
             )
-            return (grads_acc, (loss_acc[0] + loss, loss_acc[1] + 1.0)), None
+            return (
+                grads_acc,
+                (loss_acc[0] + loss, loss_acc[1] + 1.0),
+                quant,
+            ), None
 
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, acc_dtype), state.params
         )
-        (grads, (loss_sum, _)), _ = jax.lax.scan(
+        (grads, (loss_sum, _), final_quant), _ = jax.lax.scan(
             micro_grads,
             (
                 zero_grads,
                 (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                state.quant,
             ),
             batch,
             unroll=grad_accum_steps <= 4,
         )
-        new_state = state.apply_gradients(grads)
+        new_state = state.apply_gradients(grads).replace(quant=final_quant)
         return new_state, {
             "loss": loss_sum,
             "pipeline_bubble": jnp.float32(bubble),
@@ -777,14 +966,6 @@ class GPipeClassifier:
                              "(the stage axis shards the stacked layer dim)")
         if config.causal:
             raise ValueError("GPipeClassifier is an encoder-classifier trunk")
-        if getattr(config, "quant_delayed", False):
-            # the pipeline trunk applies layers as raw functions — there is
-            # no flax "quant" collection to carry amaxes through; dynamic
-            # int8 (stateless) works, delayed scaling does not
-            raise ValueError(
-                "quant_delayed is unsupported under the GPipe pipeline; "
-                "use dynamic int8 (matmul_impl alone) or the serial trunk"
-            )
         self.config = config
         self.mesh = mesh
         self.n_micro = int(n_micro)
@@ -820,6 +1001,7 @@ class GPipeClassifier:
         position_ids=None,
         deterministic: bool = True,
         rngs=None,
+        mutable=False,
     ):
         from pytorch_distributed_training_tpu.models.bert import (
             default_position_ids,
@@ -876,7 +1058,19 @@ class GPipeClassifier:
             )
             mb_keys = jax.random.key_data(keys)
             rng_impl = jax.random.key_impl(base)
-        layer_fn = gpipe_trunk_fn(cfg, with_dropout=dropout_on)
+        # delayed int8 (ops/quant.py): thread the trunk amaxes through the
+        # schedule's tick carry — every pipeline microbatch quantizes with
+        # the previous one's observations, per stage. Heads have no quant
+        # sites (plain nn.Dense, models/bert.py).
+        quant = variables.get("quant") if cfg.quant_delayed else None
+        trunk_q = (
+            quant["bert"]["layers_scan"]["layer"]
+            if quant is not None
+            else None
+        )
+        layer_fn = gpipe_trunk_fn(
+            cfg, with_dropout=dropout_on, with_quant=trunk_q is not None
+        )
         out = gpipe_apply(
             self.mesh,
             layer_fn,
@@ -886,13 +1080,32 @@ class GPipeClassifier:
             stream_spec=P(None, self.batch_axes),
             mb_keys=mb_keys,
             rng_impl=rng_impl,
+            stacked_quant=trunk_q,
         )
+        if trunk_q is not None:
+            out, new_trunk_q = out
         x = out.reshape(batch, *out.shape[2:])
         pooled = self._pool.apply(
             {"params": {"pooler": bert["pooler"]}}, x, deterministic,
             rngs=rngs,
         )
-        return self._head.apply(
+        logits = self._head.apply(
             {"params": {"classifier": params["classifier"]}},
             pooled, deterministic, rngs=rngs,
         )
+        if mutable:
+            # flax apply contract (train/step.py::_apply): (out, updated)
+            if trunk_q is None:
+                raise ValueError(
+                    "mutable=['quant'] apply needs a 'quant' collection in "
+                    "variables and quant_delayed=True on the config"
+                )
+            new_quant = {
+                **quant,
+                "bert": {
+                    **quant["bert"],
+                    "layers_scan": {"layer": new_trunk_q},
+                },
+            }
+            return logits, {"quant": new_quant}
+        return logits

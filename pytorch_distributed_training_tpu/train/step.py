@@ -86,7 +86,20 @@ def calibrate_quant(state: TrainState, micro) -> TrainState:
     def _cal(st, m):
         return _apply(st, st.params, m, None, st.quant)[1]
 
-    return state.replace(quant=jax.jit(_cal)(state, micro))
+    new_q = jax.jit(_cal)(state, micro)
+    # keep every amax leaf on its ORIGINAL sharding: under the pipeline
+    # policies the [num_layers] dim is stage-sharded, and the train step's
+    # in_shardings reject the jit default (replicated) placement
+    new_q = jax.tree.map(
+        lambda new, old: (
+            jax.device_put(new, old.sharding)
+            if isinstance(getattr(old, "sharding", None), jax.sharding.Sharding)
+            else new
+        ),
+        new_q,
+        state.quant,
+    )
+    return state.replace(quant=new_q)
 
 
 def _classification_loss(state: TrainState, params, micro, dropout_rng,

@@ -491,22 +491,315 @@ def test_1f1b_step_matches_standard_step_at_dropout0(eight_devices):
 
 def test_pipeline_rejects_unsupported_configs(eight_devices):
     """Clear ValueErrors for the combos the pipeline trunks cannot run
-    (raw-function layer application: no flax quant collection; 1F1B needs
-    the stacked layer dim) — instead of deep flax/KeyError failures."""
+    (1F1B needs the stacked layer dim) — instead of deep flax/KeyError
+    failures."""
     from pytorch_distributed_training_tpu.parallel.pipeline import (
-        GPipeClassifier,
         make_1f1b_train_step,
     )
 
     mesh = build_mesh(MeshConfig(data=4, stage=2))
-    qcfg = model_preset(
-        "tiny", scan_layers=True, matmul_impl="int8_full", quant_delayed=True
-    )
-    with pytest.raises(ValueError, match="quant_delayed"):
-        GPipeClassifier(qcfg, mesh, n_micro=2)
-    with pytest.raises(ValueError, match="quant_delayed"):
-        make_1f1b_train_step(qcfg, mesh, None, n_micro=2, grad_accum_steps=1)
     with pytest.raises(ValueError, match="scan_layers"):
         make_1f1b_train_step(
             model_preset("tiny"), mesh, None, n_micro=2, grad_accum_steps=1
         )
+
+
+# ------------------------------------- delayed int8 through the schedules
+
+
+@pytest.fixture(scope="module")
+def quant_setup(eight_devices):
+    """tiny int8_full + delayed-scaling scan model, with the trunk amaxes
+    CALIBRATED by one sequential chunk pass (zeros-init amaxes would make
+    every path emit ~zero activations — deterministic but meaningless)."""
+    qcfg = model_preset(
+        "tiny", compute_dtype="float32", num_layers=4,
+        hidden_dropout=0.0, attention_dropout=0.0, scan_layers=True,
+        matmul_impl="int8_full", quant_delayed=True,
+    )
+    model = BertForSequenceClassification(qcfg)
+    ids = jnp.ones((4, 16), jnp.int32)
+    v = model.init(jax.random.key(0), ids)
+    stacked = v["params"]["bert"]["layers_scan"]["layer"]
+    rng = np.random.default_rng(0)
+    n_micro, mb, seq = 4, 2, 16
+    xs = jnp.asarray(
+        rng.normal(size=(n_micro, mb, seq, qcfg.hidden_size)), jnp.float32
+    )
+    mask = jnp.asarray(rng.integers(0, 2, (n_micro, mb, seq)), jnp.int32)
+    mask = mask.at[:, :, 0].set(1)
+    biases = jax.vmap(make_attention_bias)(mask)
+    layer_fn = gpipe_trunk_fn(qcfg, with_quant=True)
+
+    def seq_chunk(x, b, q):
+        """One microbatch through all layers, carrying per-layer amaxes —
+        the sequential reference for the schedules' delayed semantics."""
+
+        def body(h, lp_q):
+            lp, ql = lp_q
+            return layer_fn(lp, h, b, ql)
+
+        return jax.lax.scan(body, x, (stacked, q))
+
+    q_init = v["quant"]["bert"]["layers_scan"]["layer"]
+    _, q0 = seq_chunk(xs[0], biases[0], q_init)  # calibration pass
+    return qcfg, model, stacked, q0, xs, biases, layer_fn, seq_chunk
+
+
+@pytest.mark.slow
+def test_gpipe_delayed_quant_matches_chunked_sequential(quant_setup):
+    """GPipe with the quant carry == running the chunks sequentially with
+    the same per-microbatch delayed amax updates: identical activations
+    AND identical carried-out amaxes (replicated stream — per-site update
+    order is microbatch order on both paths)."""
+    qcfg, _, stacked, q0, xs, biases, layer_fn, seq_chunk = quant_setup
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    out, q_new = gpipe_apply(
+        mesh, layer_fn, stacked, xs, biases, stacked_quant=q0
+    )
+
+    outs, q = [], q0
+    for m in range(xs.shape[0]):
+        o, q = seq_chunk(xs[m], biases[m], q)
+        outs.append(np.asarray(o))
+    np.testing.assert_allclose(
+        np.asarray(out), np.stack(outs), atol=2e-5, rtol=2e-5
+    )
+    for a, b in zip(jax.tree.leaves(q_new), jax.tree.leaves(q)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6
+        )
+
+
+@pytest.mark.slow
+def test_one_f_one_b_delayed_quant_matches_sequential(quant_setup):
+    """1F1B with the quant stash: loss/grads/cotangents AND final amaxes
+    match the sequential reference that carries the same delayed updates.
+    The stash is what makes this exact — the backward tick re-quantizes
+    with the scales its forward actually used, not the advanced carry."""
+    import optax
+
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        one_f_one_b_grads,
+    )
+
+    qcfg, _, stacked, q0, xs, biases, layer_fn, seq_chunk = quant_setup
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    n_micro, mb = xs.shape[0], xs.shape[1]
+    rng = np.random.default_rng(7)
+    hp = {
+        "w": jnp.asarray(rng.normal(size=(qcfg.hidden_size, 2)) * 0.1,
+                         jnp.float32),
+        "b": jnp.zeros((2,), jnp.float32),
+    }
+    labels = jnp.asarray(rng.integers(0, 2, (n_micro, mb)), jnp.int32)
+
+    def head_fn(hp, y, lab):
+        logits = y[:, 0] @ hp["w"] + hp["b"]
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, lab)
+        return ce.mean() / n_micro
+
+    loss, tg, hg, dxs, q_new = one_f_one_b_grads(
+        mesh, layer_fn, head_fn, stacked, hp, xs, biases, labels,
+        stacked_quant=q0,
+    )
+
+    def ref_loss(p, h, x):
+        q, total = q0, 0.0
+        for m in range(n_micro):
+
+            def body(hh, lp_q, _b=biases[m]):
+                lp, ql = lp_q
+                return layer_fn(lp, hh, _b, ql)
+
+            y, q = jax.lax.scan(body, x[m], (p, q))
+            total = total + head_fn(h, y, labels[m])
+        return total, q
+
+    (rl, rq), (gp, gh, gx) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2), has_aux=True
+    )(stacked, hp, xs)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(q_new), jax.tree.leaves(rq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(dxs), np.asarray(gx), atol=2e-4, rtol=2e-4
+    )
+    for a, b in zip(jax.tree.leaves(hg), jax.tree.leaves(gh)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )
+    for a, b in zip(jax.tree.leaves(tg), jax.tree.leaves(gp)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )
+
+
+@pytest.mark.slow
+def test_gpipe_classifier_delayed_quant_mutable_contract(quant_setup):
+    """GPipeClassifier.apply honors the flax mutable-quant contract the
+    Trainer's step uses: (logits, {"quant": updated}) with every trunk
+    amax advanced; re-applying immutably with the updated collection is
+    deterministic."""
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        GPipeClassifier,
+    )
+
+    qcfg, model, _, _, _, _, _, _ = quant_setup
+    mesh = build_mesh(MeshConfig(data=2, stage=4))
+    gp = GPipeClassifier(qcfg, mesh, n_micro=4)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, qcfg.vocab_size, (8, 16)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (8, 16)), jnp.int32).at[:, 0].set(1)
+    v = model.init(jax.random.key(0), ids, mask)
+    variables = {"params": v["params"], "quant": v["quant"]}
+
+    logits, mut = gp.apply(
+        variables, ids, mask, deterministic=True, mutable=["quant"]
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+    new_q = mut["quant"]
+    assert jax.tree_util.tree_structure(new_q) == jax.tree_util.tree_structure(
+        v["quant"]
+    )
+    for leaf in jax.tree.leaves(new_q["bert"]["layers_scan"]["layer"]):
+        assert (np.asarray(leaf) > 0).all()  # every site observed real amaxes
+
+    again = gp.apply(
+        {"params": v["params"], "quant": new_q}, ids, mask,
+        deterministic=True,
+    )
+    out2, mut2 = gp.apply(
+        {"params": v["params"], "quant": new_q}, ids, mask,
+        deterministic=True, mutable=["quant"],
+    )
+    np.testing.assert_array_equal(np.asarray(again), np.asarray(out2))
+    # purity: identical variables + inputs -> bit-identical observations
+    out3, mut3 = gp.apply(
+        {"params": v["params"], "quant": new_q}, ids, mask,
+        deterministic=True, mutable=["quant"],
+    )
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out3))
+    for a, b in zip(jax.tree.leaves(mut2["quant"]), jax.tree.leaves(mut3["quant"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_gpipe_train_step_delayed_quant_e2e(quant_setup, eight_devices):
+    """The standard train step differentiates THROUGH the GPipe schedule
+    with the quant carry: jax.grad over gpipe_apply + the mutable amax
+    contract. Pins the stop_gradient on the carry (the cross-shard pmax
+    has no AD rule — caught end-to-end, not by the forward-only tests)."""
+    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+    from pytorch_distributed_training_tpu.parallel import (
+        ShardingPolicy,
+        state_shardings,
+    )
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        GPipeClassifier,
+    )
+    from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+    from pytorch_distributed_training_tpu.train import (
+        adamw_with_schedule,
+        calibrate_quant,
+        create_train_state,
+        make_train_step,
+    )
+    from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+    qcfg = quant_setup[0]
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    model = GPipeClassifier(qcfg, mesh, n_micro=2)
+    tx, _ = adamw_with_schedule(TrainConfig(), 100)
+    example = {
+        "input_ids": jnp.ones((8, 16), jnp.int32),
+        "attention_mask": jnp.ones((8, 16), jnp.int32),
+        "token_type_ids": jnp.zeros((8, 16), jnp.int32),
+    }
+    s = create_train_state(model, tx, jax.random.key(0), example)
+    assert s.quant is not None
+    shardings = state_shardings(s, ShardingPolicy(stage=True), mesh)
+    s = shard_state(s, shardings)
+    rng = np.random.default_rng(9)
+    batch = {
+        "input_ids": rng.integers(0, qcfg.vocab_size, (2, 8, 16)).astype(
+            np.int32
+        ),
+        "attention_mask": np.ones((2, 8, 16), np.int32),
+        "token_type_ids": np.zeros((2, 8, 16), np.int32),
+        "labels": rng.integers(0, 2, (2, 8)).astype(np.int32),
+    }
+    placed = make_global_batch(mesh, batch, pspec=TRAIN_BATCH_PSPEC)
+    s = calibrate_quant(s, jax.tree.map(lambda x: x[0], placed))
+    step = make_train_step(
+        grad_accum_steps=2, mesh=mesh, state_shardings=shardings,
+    )
+    s2, m = step(s, placed)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["grad_norm"]) > 0.0
+    after = [np.asarray(x) for x in jax.tree.leaves(jax.device_get(s2.quant))]
+    assert all((x > 0).all() for x in after)
+
+
+@pytest.mark.slow
+def test_1f1b_train_step_delayed_quant_e2e(quant_setup, eight_devices):
+    """make_1f1b_train_step with quant_delayed: the amaxes ride the
+    accumulation scan and land back in TrainState.quant, advanced."""
+    from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+    from pytorch_distributed_training_tpu.models import (
+        BertForSequenceClassification,
+    )
+    from pytorch_distributed_training_tpu.parallel import (
+        ShardingPolicy,
+        state_shardings,
+    )
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        make_1f1b_train_step,
+    )
+    from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+    from pytorch_distributed_training_tpu.train import (
+        adamw_with_schedule,
+        calibrate_quant,
+        create_train_state,
+    )
+    from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+    qcfg = quant_setup[0]
+    model = BertForSequenceClassification(qcfg)
+    mesh = build_mesh(MeshConfig(data=2, stage=4))
+    tx, _ = adamw_with_schedule(TrainConfig(), 100)
+    example = {
+        "input_ids": jnp.ones((2, 16), jnp.int32),
+        "attention_mask": jnp.ones((2, 16), jnp.int32),
+        "token_type_ids": jnp.zeros((2, 16), jnp.int32),
+    }
+    s = create_train_state(model, tx, jax.random.key(0), example)
+    assert s.quant is not None
+    shardings = state_shardings(s, ShardingPolicy(stage=True), mesh)
+    s = shard_state(s, shardings)
+    rng = np.random.default_rng(5)
+    batch = {
+        "input_ids": rng.integers(0, qcfg.vocab_size, (2, 8, 16)).astype(
+            np.int32
+        ),
+        "attention_mask": np.ones((2, 8, 16), np.int32),
+        "token_type_ids": np.zeros((2, 8, 16), np.int32),
+        "labels": rng.integers(0, 2, (2, 8)).astype(np.int32),
+    }
+    placed = make_global_batch(mesh, batch, pspec=TRAIN_BATCH_PSPEC)
+    s = calibrate_quant(s, jax.tree.map(lambda x: x[0], placed))
+    before = [np.asarray(x) for x in jax.tree.leaves(jax.device_get(s.quant))]
+
+    step = make_1f1b_train_step(
+        qcfg, mesh, shardings, n_micro=4, grad_accum_steps=2
+    )
+    s2, m = step(s, placed)
+    assert np.isfinite(float(m["loss"]))
+    after = [np.asarray(x) for x in jax.tree.leaves(jax.device_get(s2.quant))]
+    # amaxes advanced through the schedule
+    assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+    assert all((x > 0).all() for x in after)
+    s3, m3 = step(s2, placed)  # second step consumes the carried scales
+    assert np.isfinite(float(m3["loss"]))
