@@ -71,6 +71,9 @@ def synthetic_lm_task(
     }
 
 
+MARKER_BAND = 64  # per-class marker sub-vocab width for multi-class tasks
+
+
 def synthetic_pair_task(
     n_examples: int,
     *,
@@ -82,9 +85,25 @@ def synthetic_pair_task(
 ) -> dict[str, np.ndarray]:
     """Generate a paraphrase-detection-shaped dataset.
 
-    label 1: segment B = segment A with ~15% token noise (a "paraphrase");
-    label 0: segment B = unrelated random tokens. With num_labels > 2 the
-    extra classes get graded noise levels (for MNLI-shaped runs).
+    Binary (MRPC-shaped): label 1 = segment B is segment A with ~15% token
+    noise (a "paraphrase"), label 0 = unrelated random tokens. This branch
+    is byte-stable across rounds — the bert-large recipe artifacts
+    (HISTORY_bert_large_recipe*) compare runs of exactly this stream.
+
+    Multi-class (MNLI-shaped): every class is a noised copy whose noise
+    RATE grades with the class (15/30/45%…) and whose replacement tokens
+    come from a class-specific marker band at the bottom of the vocab
+    (segment A and the un-noised tokens draw from above the bands). The
+    marker cue is deliberately TYPE-ID-FREE: the round-4 bisect
+    (NOTES.md) proved the old graded-noise-only form was unlearnable from
+    random init for models with a single-row token-type table (RoBERTa's
+    HF-parity layout) — token-type embeddings tag every token with its
+    segment, so BERT could learn "compare A to B" immediately while
+    RoBERTa's only segment signal (the SEP boundary) was too weak to get
+    the discrimination off the ground in ~100 updates. Marker identity is
+    readable by ANY trunk from token embeddings alone, so the MNLI-recipe
+    runs (BASELINE.json configs[3]) show a metric that moves — the
+    reference's own verification style (test_data_parallelism.py:164-166).
     """
     rng = np.random.default_rng(seed)
     first = SEP_ID + 1
@@ -92,22 +111,42 @@ def synthetic_pair_task(
     token_type = np.zeros((n_examples, max_length), np.int32)
     mask = np.zeros((n_examples, max_length), np.int32)
     labels = rng.integers(0, num_labels, n_examples).astype(np.int32)
+    # multi-class: reserve [first, first + num_labels*MARKER_BAND) for the
+    # per-class marker bands; content tokens start above them
+    content_lo = (
+        first + num_labels * MARKER_BAND if num_labels > 2 else first
+    )
+    if content_lo >= vocab_size:
+        raise ValueError(
+            f"vocab_size {vocab_size} too small for {num_labels} marker "
+            f"bands of {MARKER_BAND} tokens (content range starts at "
+            f"{content_lo})"
+        )
 
     for i in range(n_examples):
         la = int(rng.integers(*seg_len_range))
         lb = int(rng.integers(*seg_len_range))
-        a = rng.integers(first, vocab_size, la)
         label = labels[i]
-        if label == num_labels - 1:
-            # unrelated
-            b = rng.integers(first, vocab_size, lb)
-        else:
-            # copy of A with label-graded noise (label 0 = cleanest copy)
+        if num_labels > 2:
+            a = rng.integers(content_lo, vocab_size, la)
             noise = 0.15 * (label + 1)
             b = a.copy()
             flip = rng.random(la) < noise
-            b[flip] = rng.integers(first, vocab_size, flip.sum())
+            band_lo = first + int(label) * MARKER_BAND
+            b[flip] = rng.integers(band_lo, band_lo + MARKER_BAND, flip.sum())
             lb = la
+        else:
+            a = rng.integers(first, vocab_size, la)
+            if label == num_labels - 1:
+                # unrelated
+                b = rng.integers(first, vocab_size, lb)
+            else:
+                # copy of A with ~15% noise (the "paraphrase")
+                noise = 0.15 * (label + 1)
+                b = a.copy()
+                flip = rng.random(la) < noise
+                b[flip] = rng.integers(first, vocab_size, flip.sum())
+                lb = la
         ids, types = assemble_pair_row(
             a[:la].tolist(), b[:lb].tolist(), max_length
         )
