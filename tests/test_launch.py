@@ -31,29 +31,59 @@ def _epoch_record(stdout: str) -> dict:
     return {"train_loss": float(m.group(1)), "accuracy": float(m.group(2))}
 
 
-@pytest.mark.slow
-def test_two_process_train_matches_single_process(tmp_path):
-    """2 processes x 2 devices must train the same model as 1 process x 4
-    devices: same global batches (host-sharded halves), same psum'd grads,
-    same metrics — the property that keeps multi-host runs trustworthy."""
+def _assert_multi_matches_single(train_cmd, *, nprocs=2, devices_per_proc=2):
+    """Run ``train_cmd`` under the launcher twice — nprocs × devices each,
+    then one process holding the whole mesh — and pin equal metrics."""
+    total = nprocs * devices_per_proc
     multi = subprocess.run(
-        LAUNCH + ["--nprocs", "2", "--devices-per-proc", "2", "--"] + TRAIN,
+        LAUNCH + ["--nprocs", str(nprocs), "--devices-per-proc",
+                  str(devices_per_proc), "--"] + train_cmd,
         capture_output=True, text=True, timeout=540,
     )
     assert multi.returncode == 0, multi.stdout[-3000:] + multi.stderr[-2000:]
     rec_multi = _epoch_record(multi.stdout)
 
     single = subprocess.run(
-        LAUNCH + ["--nprocs", "1", "--devices-per-proc", "4", "--"] + TRAIN,
+        LAUNCH + ["--nprocs", "1", "--devices-per-proc", str(total), "--"]
+        + train_cmd,
         capture_output=True, text=True, timeout=540,
     )
-    assert single.returncode == 0, single.stdout[-3000:] + single.stderr[-2000:]
+    assert single.returncode == 0, (
+        single.stdout[-3000:] + single.stderr[-2000:]
+    )
     rec_single = _epoch_record(single.stdout)
 
     np.testing.assert_allclose(
         rec_multi["train_loss"], rec_single["train_loss"], rtol=1e-4
     )
     assert rec_multi["accuracy"] == rec_single["accuracy"]
+
+
+@pytest.mark.slow
+def test_two_process_train_matches_single_process(tmp_path):
+    """2 processes x 2 devices must train the same model as 1 process x 4
+    devices: same global batches (host-sharded halves), same psum'd grads,
+    same metrics — the property that keeps multi-host runs trustworthy."""
+    _assert_multi_matches_single(TRAIN)
+
+
+@pytest.mark.slow
+def test_two_process_hybrid_dp_mp_matches_single_process(tmp_path):
+    """The reference's ACTUAL model-parallel regime is multi-process DDP
+    wrapping a multi-device module (test_model_parallelism.py:248-253,333).
+    Its twin here: 2 processes × 2 devices over a data=2 × model=2 mesh —
+    DP across processes, the branch-ensemble's branches split over the
+    model axis WITHIN each process — must train the same model as one
+    process holding the whole 4-device mesh."""
+    _assert_multi_matches_single([
+        sys.executable, "-m",
+        "pytorch_distributed_training_tpu.cli.train_mp",
+        "--model", "tiny", "--mp-mode", "branch", "--n-branches", "2",
+        "--mesh-data", "2", "--mesh-model", "2",
+        "--num-epochs", "1", "--train-size", "64", "--eval-size", "32",
+        "--global-batch-size", "16", "--micro-batch-size", "8",
+        "--native-loader", "off", "--log-every", "0",
+    ])
 
 
 @pytest.mark.slow

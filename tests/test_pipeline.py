@@ -504,6 +504,97 @@ def test_pipeline_rejects_unsupported_configs(eight_devices):
         )
 
 
+@pytest.mark.slow
+def test_1f1b_memory_scales_with_stages_not_microbatches(eight_devices):
+    """Compiled-artifact evidence for the 1F1B memory claim (single-chip
+    hardware cannot wall-clock a pipeline, so assert over XLA's buffer
+    assignment instead): holding the pipeline-microbatch SIZE fixed and
+    raising the count, GPipe's temp allocation grows by the per-tick
+    layer-residual stash (jax.grad keeps every microbatch's activations),
+    while 1F1B grows only by the unavoidable O(n_micro) stream buffers
+    (inputs/outputs/cotangents) — its residual stash is the [2*stages]
+    circular buffer. Collective counts stay CONSTANT in n_micro for both
+    (the schedules are rolled lax.scans, one ppermute per hop in the
+    body) — the schedule adds ticks, not program size."""
+    from pytorch_distributed_training_tpu.parallel import (
+        ShardingPolicy,
+        state_shardings,
+    )
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        GPipeClassifier,
+        make_1f1b_train_step,
+    )
+    from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+    from pytorch_distributed_training_tpu.train import (
+        adamw_with_schedule,
+        create_train_state,
+        make_train_step,
+    )
+    from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+    cfg = model_preset(
+        "tiny", compute_dtype="float32", num_layers=4,
+        hidden_dropout=0.0, attention_dropout=0.0, scan_layers=True,
+    )
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    tx, _ = adamw_with_schedule(TrainConfig(), 100)
+    chunk = 8  # rows per pipeline microbatch, held FIXED across the sweep
+
+    def stats_for(n_micro):
+        rows = chunk * n_micro
+        ex = {
+            "input_ids": jnp.ones((rows, 16), jnp.int32),
+            "attention_mask": jnp.ones((rows, 16), jnp.int32),
+            "token_type_ids": jnp.zeros((rows, 16), jnp.int32),
+        }
+        batch = {
+            "input_ids": jnp.ones((2, rows, 16), jnp.int32),
+            "attention_mask": jnp.ones((2, rows, 16), jnp.int32),
+            "token_type_ids": jnp.zeros((2, rows, 16), jnp.int32),
+            "labels": jnp.zeros((2, rows), jnp.int32),
+        }
+        out = {}
+        gp = GPipeClassifier(cfg, mesh, n_micro=n_micro)
+        s = create_train_state(gp, tx, jax.random.key(0), ex)
+        sh = state_shardings(s, ShardingPolicy(stage=True), mesh)
+        s = shard_state(s, sh)
+        step = make_train_step(
+            grad_accum_steps=2, mesh=mesh, state_shardings=sh,
+            log_grad_norm=False,
+        )
+        c = step.lower(s, batch).compile()
+        out["gpipe"] = (
+            c.memory_analysis().temp_size_in_bytes,
+            c.as_text().count("collective-permute"),
+        )
+        # GPipeClassifier.init delegates to the serial flax model, so the
+        # same state/shardings serve the 1F1B step (it never reads
+        # state.apply_fn — the schedule owns its modules)
+        fstep = make_1f1b_train_step(
+            cfg, mesh, sh, n_micro=n_micro, grad_accum_steps=2
+        )
+        c = fstep.lower(s, batch).compile()
+        out["1f1b"] = (
+            c.memory_analysis().temp_size_in_bytes,
+            c.as_text().count("collective-permute"),
+        )
+        return out
+
+    r4, r8 = stats_for(4), stats_for(8)
+    gpipe_slope = (r8["gpipe"][0] - r4["gpipe"][0]) / 4  # bytes per added mb
+    f1b_slope = (r8["1f1b"][0] - r4["1f1b"][0]) / 4
+    # measured on this image: ~774k vs ~51k per added microbatch (15x);
+    # assert the structural gap with wide margins, not the exact bytes
+    assert gpipe_slope > 0, (r4, r8)
+    assert f1b_slope < gpipe_slope / 5, (
+        f"1F1B temp memory slope {f1b_slope/1e3:.1f}k/microbatch not "
+        f"clearly below GPipe's {gpipe_slope/1e3:.1f}k/microbatch"
+    )
+    # program size (and collective count) independent of the tick count
+    assert r4["gpipe"][1] == r8["gpipe"][1] > 0
+    assert r4["1f1b"][1] == r8["1f1b"][1] > 0
+
+
 # ------------------------------------- delayed int8 through the schedules
 
 
