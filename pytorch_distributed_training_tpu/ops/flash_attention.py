@@ -24,9 +24,13 @@ custom-VJP bwd, designed for the TPU memory hierarchy:
   (decoder family). Anything fancier falls back to the reference einsum
   implementation rather than silently mis-masking.
 
-Backward follows the standard two-pass flash scheme: a dq pass gridded over
-q-blocks and a dk/dv pass gridded over k-blocks, both recomputing probs from
-q, k and the saved logsumexp (rematerialization instead of HBM round-trips).
+Backward: the default is a FUSED single pass gridded over k-blocks
+(``_dqkv_kernel``) — probs recomputed ONCE per block from q, k and the
+saved logsumexp, dk/dv formed locally and dq accumulated in a VMEM scratch
+across the sequential grid (rematerialization instead of HBM round-trips,
+and half the recompute of the classic scheme). The classic two-pass
+backward (a dq pass over q-blocks + a dk/dv pass over k-blocks, each
+recomputing probs) is kept behind ``FUSED_BWD = False`` for A/B runs.
 """
 
 from __future__ import annotations
@@ -53,6 +57,10 @@ from pytorch_distributed_training_tpu.ops.attention import (
 # Shorter sequences clamp to seq length in the adapter below.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+# Fused single-pass backward (dq+dk+dv from one probs recompute) vs the
+# classic two-pass scheme — see _dqkv_kernel. Module-level so bench
+# scripts can A/B it (same pattern as the block-size globals above).
+FUSED_BWD = True
 _LANES = 128  # minor-dim tile width for fp32 stats outputs
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 
@@ -239,6 +247,58 @@ def _dq_kernel(
     dq_ref[0, 0, :, :] = (dq * scale).astype(dq_ref.dtype)
 
 
+def _kblock_bwd_math(
+    refs, k, v, bias, qi, kj, *,
+    scale, block_q, block_k, causal, dropout_rate, bh, num_qb, num_kb,
+):
+    """ONE q-block's contribution at a fixed k-block: (dv_add, dk_add, ds).
+
+    The shared body of the two k-gridded backward kernels — the classic
+    ``_dkv_kernel`` and the fused ``_dqkv_kernel`` differ ONLY in what
+    they do with ``ds`` (the fused one also accumulates dq), so the math
+    lives once and the ``FUSED_BWD`` A/B compares the same algorithm.
+    """
+    seed_ref, q_ref, do_ref, lse_ref, delta_ref = refs
+    qs = pl.ds(qi * block_q, block_q)
+    q = q_ref[0, 0, qs, :].astype(jnp.float32) * scale
+    do = do_ref[0, 0, qs, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, qs, :1]  # [block_q, 1]
+    delta = delta_ref[0, 0, qs, :1]
+    s = jax.lax.dot_general(
+        q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + bias
+    if causal:
+        s = s + _causal_block_mask(qi, kj, block_q, block_k)
+    p = jnp.exp(s - lse)  # [block_q, block_k] — the one probs recompute
+
+    if dropout_rate > 0.0:
+        pltpu.prng_seed(
+            seed_ref[0], _block_seed(bh, qi, kj, num_qb, num_kb)
+        )
+        keep = _keep_mask((block_q, block_k), dropout_rate)
+        p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    else:
+        p_drop = p
+    dv_add = jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if dropout_rate > 0.0:
+        dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+    ds = p * (dp - delta)
+    dk_add = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dv_add, dk_add, ds
+
+
 def _dkv_kernel(
     seed_ref,
     q_ref,  # [1, 1, S, D]   (full q per (b, n))
@@ -266,47 +326,16 @@ def _dkv_kernel(
     k = k_ref[0, 0, :, :]
     v = v_ref[0, 0, :, :]
     bias = bias_ref[0, 0, :, :]  # [1, block_k]
+    refs = (seed_ref, q_ref, do_ref, lse_ref, delta_ref)
 
     def body(qi, carry):
         dk, dv = carry
-        qs = pl.ds(qi * block_q, block_q)
-        q = q_ref[0, 0, qs, :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, qs, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, qs, :1]  # [block_q, 1]
-        delta = delta_ref[0, 0, qs, :1]
-        s = jax.lax.dot_general(
-            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        dv_add, dk_add, _ = _kblock_bwd_math(
+            refs, k, v, bias, qi, kj,
+            scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+            dropout_rate=dropout_rate, bh=bh, num_qb=num_qb, num_kb=num_kb,
         )
-        s = s + bias
-        if causal:
-            s = s + _causal_block_mask(qi, kj, block_q, block_k)
-        p = jnp.exp(s - lse)  # [block_q, block_k]
-
-        if dropout_rate > 0.0:
-            pltpu.prng_seed(
-                seed_ref[0], _block_seed(bh, qi, kj, num_qb, num_kb)
-            )
-            keep = _keep_mask((block_q, block_k), dropout_rate)
-            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        else:
-            p_drop = p
-        dv = dv + jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if dropout_rate > 0.0:
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
+        return dk + dk_add, dv + dv_add
 
     # under causality, q-blocks strictly before this k-block see nothing
     start_qb = (kj * block_k) // block_q if causal else 0
@@ -322,6 +351,92 @@ def _dkv_kernel(
     # q was pre-scaled, so ds @ q already carries the 1/sqrt(d) factor
     dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _dqkv_kernel(
+    seed_ref,
+    q_ref,  # [1, 1, S, D]   (full q per (b, n))
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    bias_ref,  # [1, 1, 1, block_k]
+    do_ref,  # [1, 1, S, D]
+    lse_ref,  # [1, 1, S, LANES]
+    delta_ref,  # [1, 1, S, LANES]
+    dq_ref,  # [1, 1, S, D] (q dtype) — written once, on the LAST kj
+    dk_ref,  # [1, 1, block_k, D]
+    dv_ref,  # [1, 1, block_k, D]
+    dq_acc,  # VMEM scratch [S, D] fp32 — persists across the kj grid
+    *,
+    scale: float,
+    block_q: int,
+    causal: bool,
+    dropout_rate: float,
+):
+    """FUSED single-pass backward: dq, dk and dv from ONE probs recompute.
+
+    The two-pass scheme (``_dq_kernel`` + ``_dkv_kernel``) recomputes the
+    [block_q, block_k] probs twice — two QK^T matmuls and two exp passes
+    per block, plus a full second pass of q/do/lse/delta HBM reads and a
+    second grid's worth of per-program overhead. TPU grid iterations are
+    SEQUENTIAL on a core, so gridding over k-blocks and accumulating dq
+    in a VMEM scratch that persists across iterations gets dq for free
+    while dk/dv form locally — halving the recompute; dq is cast and
+    written to HBM once, on the last k-block. (Saving probs to HBM
+    instead would cost ~S^2*2 bytes × 3 trips per head-layer — tens of
+    GB/step at seq 1024 against a ~10 ms recompute; bandwidth arithmetic
+    rules it out, so the fuse is the right probs-saving move.)
+    """
+    block_k, head_dim = k_ref.shape[2], k_ref.shape[3]
+    q_len = q_ref.shape[2]
+    num_qb = q_len // block_q
+    num_kb = pl.num_programs(2)
+    b, n, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    bh = b * pl.num_programs(1) + n
+
+    @pl.when(kj == 0)
+    def _zero_dq():
+        dq_acc[...] = jnp.zeros((q_len, head_dim), jnp.float32)
+
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    bias = bias_ref[0, 0, :, :]  # [1, block_k]
+    refs = (seed_ref, q_ref, do_ref, lse_ref, delta_ref)
+
+    def body(qi, carry):
+        dk, dv = carry
+        dv_add, dk_add, ds = _kblock_bwd_math(
+            refs, k, v, bias, qi, kj,
+            scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+            dropout_rate=dropout_rate, bh=bh, num_qb=num_qb, num_kb=num_kb,
+        )
+        # dq[qs] += ds · k, accumulated across the SEQUENTIAL kj grid dim
+        qs = pl.ds(qi * block_q, block_q)
+        dq_acc[qs, :] += (
+            jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        return dk + dk_add, dv + dv_add
+
+    start_qb = (kj * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        start_qb,
+        num_qb,
+        body,
+        (
+            jnp.zeros((block_k, head_dim), jnp.float32),
+            jnp.zeros((block_k, head_dim), jnp.float32),
+        ),
+    )
+    # q was pre-scaled, so ds @ q already carries the 1/sqrt(d) factor
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kj == num_kb - 1)
+    def _write_dq():
+        dq_ref[0, 0, :, :] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _mh_softmax(q_ref, k_ref, bias_ref, h, *, scale: float, causal: bool):
@@ -599,6 +714,76 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
     delta = jnp.broadcast_to(
         delta[..., None], (*delta.shape, _LANES)
     )  # lane-broadcast to match lse's tiling
+
+    if FUSED_BWD:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _dqkv_kernel,
+                scale=scale,
+                block_q=block_q,
+                causal=causal,
+                dropout_rate=dropout_rate,
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(batch, heads, kv_len // block_k),
+                in_specs=[
+                    pl.BlockSpec(
+                        (1, 1, q_len, head_dim),
+                        lambda b, n, kj, *_: (b, n, 0, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, block_k, head_dim),
+                        lambda b, n, kj, *_: (b, n, kj, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, block_k, head_dim),
+                        lambda b, n, kj, *_: (b, n, kj, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, 1, block_k), lambda b, n, kj, *_: (b, 0, 0, kj)
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, q_len, head_dim),
+                        lambda b, n, kj, *_: (b, n, 0, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, q_len, _LANES), lambda b, n, kj, *_: (b, n, 0, 0)
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, q_len, _LANES), lambda b, n, kj, *_: (b, n, 0, 0)
+                    ),
+                ],
+                out_specs=[
+                    # dq: same block for every kj at fixed (b, n); the
+                    # fp32 accumulator is a VMEM scratch persisting across
+                    # the sequential grid, written back (cast) on last kj
+                    pl.BlockSpec(
+                        (1, 1, q_len, head_dim),
+                        lambda b, n, kj, *_: (b, n, 0, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, block_k, head_dim),
+                        lambda b, n, kj, *_: (b, n, kj, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, 1, block_k, head_dim),
+                        lambda b, n, kj, *_: (b, n, kj, 0),
+                    ),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((q_len, head_dim), jnp.float32)
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+        )(seed, q, k, v, bias, do, lse, delta)
+        dbias = jnp.zeros_like(bias)
+        dseed = np.zeros(seed.shape, jax.dtypes.float0)
+        return dq, dk, dv, dbias, dseed
 
     dq = pl.pallas_call(
         functools.partial(
