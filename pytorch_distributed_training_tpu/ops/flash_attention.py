@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import threading
 from typing import Optional
 
@@ -59,8 +60,10 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 # Fused single-pass backward (dq+dk+dv from one probs recompute) vs the
 # classic two-pass scheme — see _dqkv_kernel. Module-level so bench
-# scripts can A/B it (same pattern as the block-size globals above).
-FUSED_BWD = True
+# scripts can A/B it (same pattern as the block-size globals above);
+# PDT_FLASH_TWO_PASS=1 flips the default from the environment so on-chip
+# A/Bs need no code edit.
+FUSED_BWD = os.environ.get("PDT_FLASH_TWO_PASS", "0") != "1"
 _LANES = 128  # minor-dim tile width for fp32 stats outputs
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-5 serialized chip session — run ONLY when nothing else is using
+# the CPU or the chip (NOTES.md pitfalls: never overlap chip work).
+#
+# Wedge discipline: a SIGTERM/SIGKILL to a chip-attached process — from
+# pkill OR from `timeout` at expiry — is what wedges the tunnel for
+# hours. So every stage is gated on a PROBE first (bench.probe_backend:
+# throwaway subprocess, never killed): if the tunnel is wedged, the
+# stage is SKIPPED and no doomed chip process is ever spawned. The
+# per-stage `timeout -k` bounds that remain are last-resort liveness
+# backstops at ~2-4x the expected stage time — if one ever fires, the
+# tunnel is already sick and the priority is finishing the session log,
+# not protecting an already-lost claim.
+#
+# Stages (each independent; a failure logs and continues):
+#   1. on-TPU test tier (the r4/r5 kernel set has never run on the chip)
+#   2. driver-style bench (delayed int8) -> the round's headline number
+#   3. missing bf16 seed-43 default-schedule gate cell (VERDICT #7)
+#   4. RoBERTa/MNLI recipe artifacts with the learnable task (VERDICT #3)
+#   5. gpt2-medium flash fused-vs-two-pass backward A/B (VERDICT #5)
+#   6. xprof trace of the delayed-int8 step (VERDICT #2)
+set -u
+cd /root/repo
+LOG=/tmp/chip_session_r5.log
+exec > >(tee -a "$LOG") 2>&1
+echo "=== chip session start: $(date -u +%FT%TZ)"
+
+probe_ok() {
+  python - <<'EOF'
+import sys, bench
+r = bench.probe_backend(budget_s=180, poll_s=5)
+print("probe:", r.get("ok"), r.get("cause", ""))
+sys.exit(0 if r.get("ok") else 1)
+EOF
+}
+
+run() {
+  local name=$1 tmo=$2; shift 2
+  if ! probe_ok; then
+    echo "--- [$name] SKIPPED: tunnel probe failed at $(date -u +%T)"
+    return 1
+  fi
+  echo "--- [$name] $(date -u +%T) bound=${tmo}s: $*"
+  timeout -k 60 "$tmo" "$@"
+  echo "--- [$name] rc=$? $(date -u +%T)"
+}
+
+# 1. on-TPU tier (serialized, generous bound, probe-gated)
+run tpu-tier 5400 env PDT_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+# 2. headline bench
+run bench 2400 python bench.py
+
+# 3. bf16 seed-43 default-schedule cell (completes the 6v6 gate matrix)
+run gate-cell 3600 python -m pytorch_distributed_training_tpu.cli.train_dp \
+  --model bert-large-cased --task synthetic --seed 43 \
+  --history-out HISTORY_bert_large_recipe_seed43.json
+
+# 4. MNLI recipe artifacts (type-id-free cue; replaces the at-chance ones)
+run mnli 5400 python -m pytorch_distributed_training_tpu.cli.train_dp \
+  --model roberta-large --task mnli \
+  --history-out HISTORY_roberta_mnli.json
+run mnli-w10 5400 python -m pytorch_distributed_training_tpu.cli.train_dp \
+  --model roberta-large --task mnli --warmup-steps 10 \
+  --history-out HISTORY_roberta_mnli_warmup10.json
+
+# 5. gpt2-medium flash backward A/B (fused default vs two-pass)
+run gpt2-fused 3600 python scripts/bench_gpt2.py "micro=4"
+run gpt2-twopass 3600 env PDT_FLASH_TWO_PASS=1 python scripts/bench_gpt2.py "micro=4"
+
+# 6. delayed-int8 step trace
+run trace 2400 python scripts/trace_step.py 24 4
+
+echo "=== chip session end: $(date -u +%FT%TZ)"
