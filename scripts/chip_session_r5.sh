@@ -68,7 +68,7 @@ run mnli-w10 5400 python -m pytorch_distributed_training_tpu.cli.train_dp \
 run gpt2-fused 3600 python scripts/bench_gpt2.py "micro=4"
 run gpt2-twopass 3600 env PDT_FLASH_TWO_PASS=1 python scripts/bench_gpt2.py "micro=4"
 
-# 6. delayed-int8 step trace
-run trace 2400 python scripts/trace_step.py 24 4
+# 6. delayed-int8 step trace (the shipping bench config)
+run trace 2400 env MATMUL=int8_full QUANT_DELAYED=1 python scripts/trace_step.py 24 4
 
 echo "=== chip session end: $(date -u +%FT%TZ)"

@@ -36,6 +36,9 @@ def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None
     _attn = {"attention_impl": _os.environ["ATTN"]} if _os.environ.get("ATTN") else {}
     if _os.environ.get("MATMUL"):
         _attn["matmul_impl"] = _os.environ["MATMUL"]
+    if _os.environ.get("QUANT_DELAYED") == "1":
+        # the shipping bench config: delayed int8 activation scaling
+        _attn["quant_delayed"] = True
     global_batch = global_batch or GLOBAL
     seq = seq or SEQ
     mesh = build_mesh()
@@ -86,6 +89,10 @@ def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None
         "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
     }
     batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
+    if state.quant is not None:
+        from pytorch_distributed_training_tpu.train.step import calibrate_quant
+
+        state = calibrate_quant(state, jax.tree.map(lambda x: x[0], batch))
     return step, state, batch
 
 
