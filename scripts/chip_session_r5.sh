@@ -71,4 +71,11 @@ run gpt2-twopass 3600 env PDT_FLASH_TWO_PASS=1 python scripts/bench_gpt2.py "mic
 # 6. delayed-int8 step trace (the shipping bench config)
 run trace 2400 env MATMUL=int8_full QUANT_DELAYED=1 python scripts/trace_step.py 24 4
 
+# 7. (lowest priority, longest run — LAST so a slow pass can't starve the
+# stages above) 6-epoch tuned MNLI artifact; 10800s keeps the 2-4x margin
+run mnli-tuned 10800 python -m pytorch_distributed_training_tpu.cli.train_dp \
+  --model roberta-large --task mnli --learning-rate 5e-5 --num-epochs 6 \
+  --warmup-steps 10 \
+  --history-out HISTORY_roberta_mnli_tuned.json
+
 echo "=== chip session end: $(date -u +%FT%TZ)"

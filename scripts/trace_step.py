@@ -38,6 +38,10 @@ def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None
         _attn["matmul_impl"] = _os.environ["MATMUL"]
     if _os.environ.get("QUANT_DELAYED") == "1":
         # the shipping bench config: delayed int8 activation scaling
+        if not str(_attn.get("matmul_impl", "")).startswith("int8"):
+            # same contract as train_dp's CLI guard: a silently-bf16 trace
+            # labeled "delayed int8" is worse than an error
+            raise SystemExit("QUANT_DELAYED=1 requires MATMUL=int8|int8_full")
         _attn["quant_delayed"] = True
     global_batch = global_batch or GLOBAL
     seq = seq or SEQ
@@ -89,10 +93,10 @@ def build_step(micro, model_name="bert-large-cased", seq=None, global_batch=None
         "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
     }
     batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
-    if state.quant is not None:
-        from pytorch_distributed_training_tpu.train.step import calibrate_quant
+    from pytorch_distributed_training_tpu.train.step import calibrate_quant
 
-        state = calibrate_quant(state, jax.tree.map(lambda x: x[0], batch))
+    # no-op unless the config carries delayed-quant state
+    state = calibrate_quant(state, jax.tree.map(lambda x: x[0], batch))
     return step, state, batch
 
 
