@@ -185,8 +185,15 @@ def ring_attention(
         )
 
     scale = q.shape[-1] ** -0.5
-    qkv_spec = P(BATCH_AXES, AXIS_SEQ, None, None)
-    bias_spec = P(BATCH_AXES, None, None, AXIS_SEQ)
+    # batch rows shard over the data axes only when they divide — a batch
+    # smaller than data×fsdp (e.g. the 2-row model-init example) computes
+    # replicated instead of failing shard_map's divisibility check; the
+    # seq axis (the op's whole point) is already guarded above
+    from pytorch_distributed_training_tpu.comms.mesh import dp_degree
+
+    batch_axes = BATCH_AXES if q.shape[0] % dp_degree(mesh) == 0 else None
+    qkv_spec = P(batch_axes, AXIS_SEQ, None, None)
+    bias_spec = P(batch_axes, None, None, AXIS_SEQ)
 
     import functools
 
