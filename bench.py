@@ -69,7 +69,9 @@ _PROBE_SRC = (
 )
 
 
-def probe_backend(budget_s: float = 600.0, poll_s: float = 5.0) -> dict:
+def probe_backend(
+    budget_s: float = 600.0, poll_s: float = 5.0, backoff_s: float = 15.0
+) -> dict:
     """Bounded probe of the JAX backend in a THROWAWAY subprocess.
 
     The axon tunnel's chip claim can be transiently wedged server-side
@@ -95,7 +97,7 @@ def probe_backend(budget_s: float = 600.0, poll_s: float = 5.0) -> dict:
 
     history = []
     deadline = time.monotonic() + budget_s
-    backoff = 15.0
+    backoff = backoff_s
     child = None
     started = 0.0
     while time.monotonic() < deadline:

@@ -1,0 +1,75 @@
+"""bench.probe_backend unit tests — the wedge-proof backend probe.
+
+The probe's contract is what round 4 lost its verification to: a
+transiently dead backend must yield a structured, diagnosable record
+(and NEVER a killed child — a SIGKILL mid-claim is what wedges the
+axon tunnel). The child command is monkeypatched so these run without
+any backend, exercising the three terminal states: success, fast
+failure with backoff-respawn, and hang-past-budget.
+"""
+
+import pytest
+
+import bench  # root-level module (pyproject pythonpath = ["."])
+
+
+@pytest.fixture
+def probe_src(monkeypatch):
+    def set_src(src):
+        monkeypatch.setattr(bench, "_PROBE_SRC", src)
+
+    return set_src
+
+
+def test_probe_success_parses_last_tokens(probe_src):
+    """Banner lines before the probe's own print must not break parsing
+    (the plugin/runtime may write to stdout first)."""
+    probe_src("print('some banner'); print('cpu 8')")
+    r = bench.probe_backend(budget_s=30, poll_s=0.2)
+    assert r["ok"] is True
+    assert r["platform"] == "cpu"
+    assert r["n_devices"] == 8
+    assert r["failed_attempts"] == []
+
+
+def test_probe_fast_failure_records_attempts_and_cause(probe_src):
+    probe_src("import sys; sys.stderr.write('boom\\n'); sys.exit(2)")
+    r = bench.probe_backend(budget_s=2, poll_s=0.2, backoff_s=0.1)
+    assert r["ok"] is False
+    assert "failed every try" in r["cause"]
+    assert r["attempts"], r
+    assert r["attempts"][0]["outcome"] == "rc=2"
+    assert "boom" in r["attempts"][0]["stderr_tail"]
+
+
+def test_probe_hang_leaves_child_running(probe_src):
+    """A child still initializing at budget exhaustion is LEFT ALIVE
+    (killing a mid-claim client is the wedge mechanism) and the record
+    says so."""
+    import os
+
+    probe_src("import time; time.sleep(4)")
+    r = bench.probe_backend(budget_s=1.0, poll_s=0.2)
+    assert r["ok"] is False
+    assert "left running" in r["cause"]
+    pid = r["hung_child_pid"]
+    # the child must still be alive — not killed by the probe
+    os.kill(pid, 0)  # raises if the process is gone
+    # (the sleeper exits on its own; nothing to clean up)
+
+
+def test_probe_success_after_failures(probe_src, tmp_path):
+    """A flaky backend that fails then recovers within the budget is
+    reported ok — the backoff-respawn path."""
+    flag = tmp_path / "second_try"
+    probe_src(
+        "import sys, os\n"
+        f"p = {str(flag)!r}\n"
+        "if not os.path.exists(p):\n"
+        "    open(p, 'w').close(); sys.exit(1)\n"
+        "print('cpu 4')\n"
+    )
+    r = bench.probe_backend(budget_s=30, poll_s=0.2, backoff_s=0.1)
+    assert r["ok"] is True, r
+    assert r["n_devices"] == 4
+    assert len(r["failed_attempts"]) == 1
