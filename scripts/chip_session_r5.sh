@@ -45,24 +45,30 @@ run() {
   echo "--- [$name] rc=$? $(date -u +%T)"
 }
 
-# 1. on-TPU tier (serialized, generous bound, probe-gated)
-run tpu-tier 5400 env PDT_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+# Stage order is unique-value-per-minute: if the tunnel recovers late in
+# the round, the artifacts only this session can produce must land first
+# (the driver re-runs bench.py itself at round end either way, but a
+# builder-verified number + HISTORY files + the tier note have no other
+# source).
 
-# 2. headline bench
+# 1. headline bench (~10 min; also validates the whole int8 path quickly)
 run bench 2400 python bench.py
 
-# 3. bf16 seed-43 default-schedule cell (completes the 6v6 gate matrix)
+# 2. bf16 seed-43 default-schedule cell (completes the 6v6 gate matrix)
 run gate-cell 3600 python -m pytorch_distributed_training_tpu.cli.train_dp \
   --model bert-large-cased --task synthetic --seed 43 \
   --history-out HISTORY_bert_large_recipe_seed43.json
 
-# 4. MNLI recipe artifacts (type-id-free cue; replaces the at-chance ones)
+# 3. MNLI recipe artifacts (type-id-free cue; replaces the at-chance ones)
 run mnli 5400 python -m pytorch_distributed_training_tpu.cli.train_dp \
   --model roberta-large --task mnli \
   --history-out HISTORY_roberta_mnli.json
 run mnli-w10 5400 python -m pytorch_distributed_training_tpu.cli.train_dp \
   --model roberta-large --task mnli --warmup-steps 10 \
   --history-out HISTORY_roberta_mnli_warmup10.json
+
+# 4. on-TPU test tier (serialized, generous bound, probe-gated)
+run tpu-tier 5400 env PDT_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
 
 # 5. gpt2-medium flash backward A/B (fused default vs two-pass)
 run gpt2-fused 3600 python scripts/bench_gpt2.py "micro=4"
