@@ -13,12 +13,13 @@
 # not protecting an already-lost claim.
 #
 # Stages (each independent; a failure logs and continues):
-#   1. on-TPU test tier (the r4/r5 kernel set has never run on the chip)
-#   2. driver-style bench (delayed int8) -> the round's headline number
-#   3. missing bf16 seed-43 default-schedule gate cell (VERDICT #7)
-#   4. RoBERTa/MNLI recipe artifacts with the learnable task (VERDICT #3)
+#   1. driver-style bench (delayed int8) -> the round's headline number
+#   2. missing bf16 seed-43 default-schedule gate cell (VERDICT #7)
+#   3. RoBERTa/MNLI recipe artifacts with the learnable task (VERDICT #3)
+#   4. on-TPU test tier (the r4/r5 kernel set has never run on the chip)
 #   5. gpt2-medium flash fused-vs-two-pass backward A/B (VERDICT #5)
 #   6. xprof trace of the delayed-int8 step (VERDICT #2)
+#   7. 6-epoch tuned MNLI artifact (longest, lowest priority)
 set -u
 cd /root/repo
 LOG=/tmp/chip_session_r5.log

@@ -638,12 +638,18 @@ def quant_setup(eight_devices):
 
 
 @pytest.mark.slow
-def test_gpipe_delayed_quant_matches_chunked_sequential(quant_setup):
+@pytest.mark.parametrize("remat", [False, True])
+def test_gpipe_delayed_quant_matches_chunked_sequential(quant_setup, remat):
     """GPipe with the quant carry == running the chunks sequentially with
     the same per-microbatch delayed amax updates: identical activations
     AND identical carried-out amaxes (replicated stream — per-site update
-    order is microbatch order on both paths)."""
+    order is microbatch order on both paths). ``remat`` wraps the
+    tuple-returning layer_fn in jax.checkpoint — the --remat × quant
+    combination must not disturb either output."""
     qcfg, _, stacked, q0, xs, biases, layer_fn, seq_chunk = quant_setup
+    if remat:
+        rcfg = dataclasses.replace(qcfg, remat=True)
+        layer_fn = gpipe_trunk_fn(rcfg, with_quant=True)
     mesh = build_mesh(MeshConfig(data=4, stage=2))
     out, q_new = gpipe_apply(
         mesh, layer_fn, stacked, xs, biases, stacked_quant=q0
@@ -776,11 +782,15 @@ def test_gpipe_classifier_delayed_quant_mutable_contract(quant_setup):
 
 
 @pytest.mark.slow
-def test_gpipe_train_step_delayed_quant_e2e(quant_setup, eight_devices):
+@pytest.mark.parametrize("dropout", [0.0, 0.1])
+def test_gpipe_train_step_delayed_quant_e2e(quant_setup, eight_devices,
+                                            dropout):
     """The standard train step differentiates THROUGH the GPipe schedule
     with the quant carry: jax.grad over gpipe_apply + the mutable amax
     contract. Pins the stop_gradient on the carry (the cross-shard pmax
-    has no AD rule — caught end-to-end, not by the forward-only tests)."""
+    has no AD rule — caught end-to-end, not by the forward-only tests).
+    dropout=0.1 additionally exercises the rng-streaming + quant layer_fn
+    variant (the 5-arg signature) through the same path."""
     from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
     from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
     from pytorch_distributed_training_tpu.parallel import (
@@ -799,7 +809,9 @@ def test_gpipe_train_step_delayed_quant_e2e(quant_setup, eight_devices):
     )
     from pytorch_distributed_training_tpu.utils.config import TrainConfig
 
-    qcfg = quant_setup[0]
+    qcfg = dataclasses.replace(
+        quant_setup[0], hidden_dropout=dropout, attention_dropout=dropout
+    )
     mesh = build_mesh(MeshConfig(data=4, stage=2))
     model = GPipeClassifier(qcfg, mesh, n_micro=2)
     tx, _ = adamw_with_schedule(TrainConfig(), 100)
