@@ -35,8 +35,20 @@ sys.exit(0 if r.get("ok") else 1)
 EOF
 }
 
+# The driver runs its own bench.py + dryrun at round end (~12:24Z for
+# this round) and MUST find the chip free — a stage still holding the
+# claim then would cost the round its driver-verified number exactly the
+# way round 4 lost it. No stage starts unless its full bound fits before
+# the deadline.
+DEADLINE=${CHIP_DEADLINE_EPOCH:-1785584700}  # 2026-08-01T11:45Z
+
 run() {
   local name=$1 tmo=$2; shift 2
+  if [ $(( $(date +%s) + tmo )) -gt "$DEADLINE" ]; then
+    echo "--- [$name] SKIPPED: bound ${tmo}s does not fit before the"\
+         "driver-bench deadline ($(date -u +%T) now)"
+    return 1
+  fi
   if ! probe_ok; then
     echo "--- [$name] SKIPPED: tunnel probe failed at $(date -u +%T)"
     return 1
