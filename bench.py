@@ -446,32 +446,50 @@ def main(argv=None):
                    help="total budget (s) for the subprocess backend probe "
                         "before declaring the tunnel down (0 = skip probe)")
     args = p.parse_args(argv)
+
+    def failure_artifact(metric: str, error: dict) -> None:
+        # Structured failure: one JSON line naming the cause, so a
+        # transiently wedged tunnel or a mid-run crash yields a
+        # diagnosable artifact instead of a bare rc=1 (round-4 lost its
+        # verification to exactly that).
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "samples/sec/chip",
+            "vs_baseline": None,
+            "error": error,
+        }))
+
     if args.probe_budget_s > 0:
         probe = probe_backend(args.probe_budget_s)
         if not probe["ok"]:
-            # Structured failure: one JSON line naming the cause, so a
-            # transiently wedged tunnel yields a diagnosable artifact
-            # instead of a bare rc=1 (round-4 lost its verification to
-            # exactly that).
-            print(json.dumps({
-                "metric": "benchmark not run: JAX backend unavailable",
-                "value": None,
-                "unit": "samples/sec/chip",
-                "vs_baseline": None,
-                "error": probe,
-            }))
+            failure_artifact(
+                "benchmark not run: JAX backend unavailable", probe
+            )
             return None
-    result = run_bench(
-        model_name=args.model,
-        global_batch=args.global_batch_size,
-        micro_batch=args.micro_batch_size,
-        seq_len=args.seq_len,
-        warmup_steps=args.warmup_steps,
-        timed_steps=args.timed_steps,
-        chain_steps=args.chain_steps,
-        matmul_impl=args.matmul_impl,
-        quant_delayed=args.quant_delayed,
-    )
+    try:
+        result = run_bench(
+            model_name=args.model,
+            global_batch=args.global_batch_size,
+            micro_batch=args.micro_batch_size,
+            seq_len=args.seq_len,
+            warmup_steps=args.warmup_steps,
+            timed_steps=args.timed_steps,
+            chain_steps=args.chain_steps,
+            matmul_impl=args.matmul_impl,
+            quant_delayed=args.quant_delayed,
+        )
+    except SystemExit:
+        raise  # argument errors keep their own message/exit code
+    except Exception as e:  # noqa: BLE001 — the artifact must name the cause
+        import traceback
+
+        failure_artifact("benchmark failed mid-run", {
+            "type": type(e).__name__,
+            "message": str(e)[-1000:],
+            "traceback_tail": traceback.format_exc()[-2000:],
+        })
+        return None
     print(json.dumps(result))
     return result
 
