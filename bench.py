@@ -172,6 +172,7 @@ def run_bench(
     chain_steps: int = 1,
     matmul_impl: str = "default",
     quant_delayed: bool | None = None,
+    quant_delayed_grads: bool = False,
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -229,6 +230,15 @@ def run_bench(
         # delayed activation scaling (ops/quant.py): amaxes carried in the
         # train state, calibrated below on the first batch
         mcfg.quant_delayed = True
+    if quant_delayed_grads:
+        # opt-in A/B knob (NOT the gated default): delayed dy scaling in
+        # the backward — requires its own convergence gate before it may
+        # ever become a default (module docstring contract)
+        if not (mcfg.quant_delayed and matmul_impl == "int8_full"):
+            raise SystemExit(
+                "--quant-delayed-grads requires delayed int8_full"
+            )
+        mcfg.quant_delayed_grads = True
     need_pos = (
         seq_len + mcfg.pad_token_id + 1 if mcfg.roberta_style else seq_len
     )
@@ -359,7 +369,9 @@ def run_bench(
         from pytorch_distributed_training_tpu.train.step import calibrate_quant
 
         state = calibrate_quant(
-            state, jax.tree.map(lambda x: x[0], place(0))
+            state, jax.tree.map(lambda x: x[0], place(0)),
+            objective=objective,
+            loss_scale=1.0 / tcfg.grad_accum_steps,
         )
 
     for i in range(warmup_calls):
@@ -394,6 +406,7 @@ def run_bench(
         "final_loss": float(jax.device_get(metrics["loss"])),
         "matmul_impl": mcfg.matmul_impl,
         "quant_delayed": mcfg.quant_delayed,
+        "quant_delayed_grads": mcfg.quant_delayed_grads,
     }
     if chain_steps > 1:
         extra["chain_steps"] = chain_steps
@@ -442,6 +455,11 @@ def main(argv=None):
                         "serialization (ops/quant.py). Default: on for "
                         "int8 impls (multi-seed convergence-gated), "
                         "meaningless otherwise")
+    p.add_argument("--quant-delayed-grads",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="A/B knob, NOT a gated default: delayed dy scaling "
+                        "in the backward (ops/quant.py sink-gradient "
+                        "channel); requires delayed int8_full")
     p.add_argument("--probe-budget-s", type=float, default=600.0,
                    help="total budget (s) for the subprocess backend probe "
                         "before declaring the tunnel down (0 = skip probe)")
@@ -478,6 +496,7 @@ def main(argv=None):
             chain_steps=args.chain_steps,
             matmul_impl=args.matmul_impl,
             quant_delayed=args.quant_delayed,
+            quant_delayed_grads=args.quant_delayed_grads,
         )
     except SystemExit:
         raise  # argument errors keep their own message/exit code

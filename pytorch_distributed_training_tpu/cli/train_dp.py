@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="delayed (previous-microbatch) int8 activation "
                         "scaling: amaxes carried in the train state, "
                         "calibrated on the first batch (ops/quant.py)")
+    p.add_argument("--quant-delayed-grads",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="extend delayed scaling to the backward's dy "
+                        "quantization (int8_full only; dy amaxes carried "
+                        "one microbatch late via the sink-gradient "
+                        "channel, ops/quant.py)")
     p.add_argument("--fsdp", action=argparse.BooleanOptionalAction,
                    default=False, help="shard params/opt state over fsdp axis")
     p.add_argument("--mesh-data", type=int, default=-1)
@@ -81,6 +87,13 @@ def main(argv=None) -> list[dict]:
         raise SystemExit(
             "--quant-delayed requires --matmul-impl int8|int8_full"
         )
+    if args.quant_delayed_grads and not (
+        args.quant_delayed and args.matmul_impl == "int8_full"
+    ):
+        raise SystemExit(
+            "--quant-delayed-grads requires --quant-delayed and "
+            "--matmul-impl int8_full"
+        )
     tcfg = dataclass_from_args(TrainConfig, args)
     # bf16 flag maps onto the model dtype policy
     from pytorch_distributed_training_tpu.cli import resolve_attention
@@ -90,6 +103,7 @@ def main(argv=None) -> list[dict]:
         compute_dtype="bfloat16" if tcfg.bf16 else "float32",
         matmul_impl=args.matmul_impl,
         quant_delayed=args.quant_delayed,
+        quant_delayed_grads=args.quant_delayed_grads,
         **resolve_attention(args.attention, args.mesh_seq),
     )
     mesh_cfg = MeshConfig(

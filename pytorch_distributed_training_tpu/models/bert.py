@@ -114,6 +114,7 @@ def dense_general(cfg: ModelConfig, features, axis, name, kw):
         features=feats, axis=ax,
         mode="full" if cfg.matmul_impl == "int8_full" else "fwd",
         delayed=cfg.quant_delayed,
+        delayed_grads=cfg.quant_delayed_grads,
         dtype=kw["dtype"], param_dtype=kw["param_dtype"],
         kernel_init=kw["kernel_init"], name=name,
     )
@@ -395,7 +396,7 @@ class BertEncoderModel(nn.Module):
                 _ScanBlock,
                 # "quant": per-layer delayed-int8 amaxes stack on the same
                 # leading [num_layers] dim as the params (no-op otherwise)
-                variable_axes={"params": 0, "quant": 0},
+                variable_axes={"params": 0, "quant": 0, "quant_sink": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,),
                 length=cfg.num_layers,

@@ -88,7 +88,7 @@ class BranchEnsembleClassifier(nn.Module):
         branches = nn.vmap(
             _EncoderStack,
             # "quant": per-branch delayed-int8 amaxes (ops/quant.py)
-            variable_axes={"params": 0, "quant": 0},
+            variable_axes={"params": 0, "quant": 0, "quant_sink": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(None, None, None),
             out_axes=0,

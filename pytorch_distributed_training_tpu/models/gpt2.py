@@ -172,7 +172,7 @@ class GPT2LMModel(nn.Module):
             scan = nn.scan(
                 _GPT2ScanBlock,
                 # "quant": per-layer delayed-int8 amaxes (ops/quant.py)
-                variable_axes={"params": 0, "quant": 0},
+                variable_axes={"params": 0, "quant": 0, "quant_sink": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,),
                 length=cfg.num_layers,

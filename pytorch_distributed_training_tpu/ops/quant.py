@@ -150,9 +150,15 @@ def _int8_dense_fwd(x, kernel, n_contract, mode):
     return y, (xq, sx, wq, sw, sent)
 
 
-def _int8_dense_bwd(n_contract, mode, res, dy):
-    xq, sx, wq, sw, sent = res
-    x_dtype, w_dtype = sent[0].dtype, sent[1].dtype
+def _full_mode_grad_dots(xq, sx, wq, dy_scaled, dy, s0, s1, n_contract,
+                         x_dtype, w_dtype):
+    """int8 dgrad/wgrad at given dy scales — THE "full"-mode backward
+    layout, shared by the dynamic and delayed-dy paths so the two cannot
+    diverge (only the scale SOURCE differs: fresh absmax vs carried).
+    ``dy_scaled`` is dy with sw pre-folded (sw varies along dx's
+    contracted f-dims; folding it before quantizing keeps one per-tensor
+    scale exact). Per-tensor scales factor straight out of the batch
+    contraction for dw."""
     nb = xq.ndim - n_contract  # batch rank
     nf = wq.ndim - n_contract  # feature rank
     # dx[b.., c..] = dy[b.., f..] · kernel[c.., f..]^T : contract f-dims
@@ -162,24 +168,39 @@ def _int8_dense_bwd(n_contract, mode, res, dy):
     )
     # dw[c.., f..] = x[b.., c..]^T · dy[b.., f..] : contract batch dims
     dw_dims = ((tuple(range(nb)), tuple(range(nb))), ((), ()))
+    dx = (
+        lax.dot_general(
+            _quantize(dy_scaled, s0), wq, dx_dims,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32) * s0
+    ).astype(x_dtype)
+    dw = (
+        lax.dot_general(
+            xq, _quantize(dy, s1), dw_dims,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32) * (sx * s1)
+    ).astype(w_dtype)
+    return dx, dw
+
+
+def _int8_dense_bwd(n_contract, mode, res, dy):
+    xq, sx, wq, sw, sent = res
+    x_dtype, w_dtype = sent[0].dtype, sent[1].dtype
+    nb = xq.ndim - n_contract  # batch rank
+    nf = wq.ndim - n_contract  # feature rank
+    dx_dims = (
+        (tuple(range(nb, nb + nf)), tuple(range(n_contract, wq.ndim))),
+        ((), ()),
+    )
+    dw_dims = ((tuple(range(nb)), tuple(range(nb))), ((), ()))
     if mode == "full":
-        # sw varies along dx's CONTRACTED f-dims — fold it into dy BEFORE
-        # quantizing so one dynamic per-tensor scale stays exact
         dy_scaled = dy.astype(jnp.float32) * sw  # broadcasts over [f..]
-        dyq2, sdy2 = quantize_per_tensor(dy_scaled)
-        dx = (
-            lax.dot_general(
-                dyq2, wq, dx_dims, preferred_element_type=jnp.int32,
-            ).astype(jnp.float32) * sdy2
-        ).astype(x_dtype)
-        # per-tensor scales factor straight out of the batch contraction
-        dyq, sdy = quantize_per_tensor(dy)
-        dw = (
-            lax.dot_general(
-                xq, dyq, dw_dims, preferred_element_type=jnp.int32,
-            ).astype(jnp.float32) * (sx * sdy)
-        ).astype(w_dtype)
-        return dx, dw
+        return _full_mode_grad_dots(
+            xq, sx, wq, dy_scaled, dy,
+            _absmax(dy_scaled, axes=None, keepdims=False) / _INT8_MAX,
+            _absmax(dy, axes=None, keepdims=False) / _INT8_MAX,
+            n_contract, x_dtype, w_dtype,
+        )
     xdq = (xq.astype(jnp.float32) * sx).astype(x_dtype)
     wdq = (wq.astype(jnp.float32) * sw).astype(x_dtype)
     dx = lax.dot_general(
@@ -242,6 +263,115 @@ def _int8_dense_delayed_bwd(n_contract, mode, res, cts):
 int8_dense_delayed.defvjp(_int8_dense_delayed_fwd, _int8_dense_delayed_bwd)
 
 
+# ------------------------------------- delayed scaling for the BACKWARD
+#
+# "full" mode still quantizes dy DYNAMICALLY in the backward: two absmax
+# reduce-to-scalar passes over dy per site per microbatch (one for the
+# sw-folded dy that feeds dx, one for raw dy feeding dw) — the same
+# serialization shape delayed activation scaling removed from the forward.
+# Carrying dy amaxes needs a channel OUT of the backward, and gradients
+# only leave a custom_vjp through cotangent slots: each site therefore
+# takes a zero-valued ``dy_sink`` input (shape [2]) whose COTANGENT the
+# backward sets to the observed [amax(dy_scaled), amax(dy)]. A caller
+# that differentiates w.r.t. the sinks reads next-microbatch dy scales
+# out of the sink gradients and carries them exactly like the forward
+# amaxes. The forward result is bit-identical to int8_dense_delayed; only
+# the backward's dy quantization scales differ (previous-microbatch
+# observations, saturating at ±127 for one microbatch when dy outgrows
+# them — the standard delayed-scaling contract).
+
+
+import threading as _threading  # noqa: E402
+import contextlib as _contextlib  # noqa: E402
+
+_DY_CAL = _threading.local()
+
+
+@_contextlib.contextmanager
+def dy_calibration_mode():
+    """Trace-time switch for :func:`int8_dense_delayed_grads`: inside this
+    context the BACKWARD quantizes dy with fresh DYNAMIC scales (while
+    still reporting observations through the sinks). Needed exactly once,
+    for calibration: with zero carried dy amaxes every downstream site
+    would otherwise differentiate through saturated garbage cotangents
+    and record garbage observations (train/step.py::calibrate_quant)."""
+    _DY_CAL.on = True
+    try:
+        yield
+    finally:
+        _DY_CAL.on = False
+
+
+def _delayed_grads_core(x, kernel, amax_prev, dy_amaxes, dy_sink, n_contract):
+    y, new_amax, res = _delayed_quantized_dot(
+        x, kernel, amax_prev, n_contract
+    )
+    # 0.0 * sum(dy_sink) makes the sink a true input of the primal, so
+    # its cotangent slot exists; XLA folds the zero away.
+    y = y + (0.0 * jnp.sum(dy_sink)).astype(y.dtype)
+    return y, new_amax, res
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def int8_dense_delayed_grads(x, kernel, amax_prev, dy_amaxes, dy_sink,
+                             n_contract: int = 1, calibrate: bool = False):
+    """:func:`int8_dense_delayed` with DELAYED dy scales in the backward.
+
+    ``dy_amaxes``: fp32 [2] — carried amaxes of (sw-folded dy, raw dy)
+    from this site's previous microbatch. ``dy_sink``: fp32 [2] zeros;
+    differentiate w.r.t. it and the gradient IS the current microbatch's
+    observed [amax(dy_scaled), amax(dy)], to be carried forward.
+    ``calibrate=True`` (bound from :func:`dy_calibration_mode` at trace
+    time) switches the backward to fresh dynamic dy scales.
+    Backward matmul layout matches ``mode="full"`` of :func:`int8_dense`.
+    """
+    return _delayed_grads_core(
+        x, kernel, amax_prev, dy_amaxes, dy_sink, n_contract
+    )[:2]
+
+
+def _int8_dense_delayed_grads_fwd(x, kernel, amax_prev, dy_amaxes, dy_sink,
+                                  n_contract, calibrate):
+    y, new_amax, (xq, scale, wq, sw) = _delayed_grads_core(
+        x, kernel, amax_prev, dy_amaxes, dy_sink, n_contract
+    )
+    sent = (jnp.zeros((0,), x.dtype), jnp.zeros((0,), kernel.dtype))
+    return (y, new_amax), (xq, scale, wq, sw, dy_amaxes, sent)
+
+
+def _int8_dense_delayed_grads_bwd(n_contract, calibrate, res, cts):
+    dy, _d_amax = cts
+    xq, sx, wq, sw, dy_amaxes, sent = res
+    x_dtype, w_dtype = sent[0].dtype, sent[1].dtype
+    dy_scaled = dy.astype(jnp.float32) * sw
+    obs0 = _absmax(dy_scaled, axes=None, keepdims=False)
+    obs1 = _absmax(dy, axes=None, keepdims=False)
+    if calibrate:
+        # dynamic scales: exact magnitudes even when every carried amax
+        # is still zero — the one-pass calibration path
+        s0, s1 = obs0 / _INT8_MAX, obs1 / _INT8_MAX
+    else:
+        # carried scales: no absmax dependency before the quantize pass
+        # (the whole point — the reduce overlaps the dots)
+        s0 = jnp.maximum(dy_amaxes[0], 1e-12) / _INT8_MAX
+        s1 = jnp.maximum(dy_amaxes[1], 1e-12) / _INT8_MAX
+    dx, dw = _full_mode_grad_dots(
+        xq, sx, wq, dy_scaled, dy, s0, s1, n_contract, x_dtype, w_dtype
+    )
+    return (
+        dx,
+        dw,
+        jnp.zeros((), jnp.float32),   # amax_prev: constant under STE
+        jnp.zeros((2,), jnp.float32),  # dy_amaxes: constants too
+        jnp.stack([obs0, obs1]),  # observations leave via the sink slot
+    )
+
+
+int8_dense_delayed_grads.defvjp(
+    _int8_dense_delayed_grads_fwd, _int8_dense_delayed_grads_bwd
+)
+
+
 def int8_matmul(x2d, w2d, mode: str = "fwd"):
     """2-D convenience wrapper over :func:`int8_dense` ([T,K]·[K,N])."""
     return int8_dense(x2d, w2d, 1, mode)
@@ -287,6 +417,7 @@ class QuantDenseGeneral(nn.Module):
     axis: tuple = (-1,)  # contracted input axes
     mode: str = "fwd"  # int8_matmul mode: "fwd" | "full"
     delayed: bool = False  # delayed activation scaling via "quant" collection
+    delayed_grads: bool = False  # ...and delayed dy scaling in the backward
     use_bias: bool = True
     dtype: object = jnp.bfloat16
     param_dtype: object = jnp.float32
@@ -314,9 +445,34 @@ class QuantDenseGeneral(nn.Module):
             amax = self.variable(
                 "quant", "amax", lambda: jnp.zeros((), jnp.float32)
             )
-            y, new_amax = int8_dense_delayed(
-                x, kernel, amax.value, len(axis), self.mode
-            )
+            if self.delayed_grads:
+                if self.mode != "full":
+                    raise ValueError(
+                        "delayed_grads implements the 'full' backward "
+                        f"layout only (got mode={self.mode!r})"
+                    )
+                # carried dy amaxes live beside the fwd amax; the fresh
+                # observations return through the SINK's gradient — the
+                # train step differentiates w.r.t. the "quant_sink"
+                # collection and merges them back (train/step.py)
+                dy_amax = self.variable(
+                    "quant", "dy_amax", lambda: jnp.zeros((2,), jnp.float32)
+                )
+                sink = self.variable(
+                    "quant_sink", "sink",
+                    lambda: jnp.zeros((2,), jnp.float32),
+                )
+                y, new_amax = int8_dense_delayed_grads(
+                    x, kernel, amax.value, dy_amax.value, sink.value,
+                    len(axis),
+                    # trace-time bind: inside dy_calibration_mode() the
+                    # backward uses fresh dynamic dy scales
+                    getattr(_DY_CAL, "on", False),
+                )
+            else:
+                y, new_amax = int8_dense_delayed(
+                    x, kernel, amax.value, len(axis), self.mode
+                )
             # init + every mutable apply observe the current amax; an
             # immutable apply (a caller that forgot mutable=["quant"]) keeps
             # the stale value rather than erroring — eval reuses training's

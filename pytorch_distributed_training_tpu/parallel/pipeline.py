@@ -753,6 +753,12 @@ def make_1f1b_train_step(
             "make_1f1b_train_step requires scan_layers=True (the schedule "
             "shards the stacked layer dim over the stage axis)"
         )
+    if getattr(cfg, "quant_delayed_grads", False):
+        raise ValueError(
+            "quant_delayed_grads is unsupported under the 1F1B schedule "
+            "(the sink-gradient channel is not threaded through the tick "
+            "vjp); use plain quant_delayed"
+        )
     n_stages = mesh.shape["stage"]
     emb = BertEmbeddings(cfg)
     pool = _PoolerHead(cfg)
@@ -966,6 +972,12 @@ class GPipeClassifier:
                              "(the stage axis shards the stacked layer dim)")
         if config.causal:
             raise ValueError("GPipeClassifier is an encoder-classifier trunk")
+        if getattr(config, "quant_delayed_grads", False):
+            raise ValueError(
+                "quant_delayed_grads is unsupported under the GPipe "
+                "schedule (the sink-gradient channel is not threaded "
+                "through jax.grad of the pipeline); use plain quant_delayed"
+            )
         self.config = config
         self.mesh = mesh
         self.n_micro = int(n_micro)

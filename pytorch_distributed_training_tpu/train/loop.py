@@ -229,7 +229,11 @@ class Trainer:
             local = take[pidx * micro_local : (pidx + 1) * micro_local]
             rows = {k: np.asarray(v)[local] for k, v in train_data.items()}
             micro0 = make_global_batch(self.mesh, rows, pspec=P(BATCH_AXES))
-            self.state = calibrate_quant(self.state, micro0)
+            self.state = calibrate_quant(
+                self.state, micro0,
+                objective=self.objective,
+                loss_scale=1.0 / train_config.grad_accum_steps,
+            )
 
         chain = train_config.chain_steps
         if chain > 1:

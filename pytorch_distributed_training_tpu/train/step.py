@@ -40,20 +40,62 @@ from pytorch_distributed_training_tpu.comms.mesh import BATCH_AXES, TRAIN_BATCH_
 from pytorch_distributed_training_tpu.train.state import TrainState
 
 
+def _sink_zeros(quant):
+    """Zero-valued "quant_sink" collection matching ``quant``'s delayed-
+    gradient sites (the leaves named ``dy_amax``) — None when the model
+    has none. The sinks are the cotangent channel that carries the
+    backward's observed dy amaxes out (ops/quant.py
+    ``int8_dense_delayed_grads``); their STRUCTURE is static, so this
+    also serves as the trace-time "is delayed_grads on?" predicate."""
+    if quant is None:
+        return None
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(quant)
+    sinks = {
+        k[:-1] + ("sink",): jnp.zeros_like(v)
+        for k, v in flat.items()
+        if k[-1] == "dy_amax"
+    }
+    return traverse_util.unflatten_dict(sinks) if sinks else None
+
+
+def _merge_dy_amaxes(quant, sink_grads):
+    """Write the backward's observed dy amaxes (the sink gradients) into
+    the ``dy_amax`` leaves of the carried quant collection."""
+    from flax import traverse_util
+
+    q = traverse_util.flatten_dict(quant)
+    s = traverse_util.flatten_dict(sink_grads)
+    merged = {
+        k: (s[k[:-1] + ("sink",)] if k[-1] == "dy_amax" else v)
+        for k, v in q.items()
+    }
+    return traverse_util.unflatten_dict(merged)
+
+
 def _apply(state: TrainState, params, micro, dropout_rng, quant=None,
-           apply_fn=None):
+           apply_fn=None, sinks=None):
     """Model forward → (output, new_quant). ``quant`` is the delayed-int8
     amax collection (ops/quant.py); when present the apply is mutable over
     it and the updated collection comes back for the caller to carry. None
     (every non-delayed model) leaves the apply exactly as before.
     ``apply_fn`` overrides ``state.apply_fn`` (the pipeline trainer
-    evaluates through the serial trunk — same params, no schedule)."""
+    evaluates through the serial trunk — same params, no schedule).
+    ``sinks`` feeds the "quant_sink" collection for delayed-gradient
+    models (built as zeros here when not supplied — callers pass their
+    own only to differentiate w.r.t. it)."""
     fn = state.apply_fn if apply_fn is None else apply_fn
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
     kwargs = dict(deterministic=dropout_rng is None, rngs=rngs)
     if quant is not None:
+        variables = {"params": params, "quant": quant}
+        if sinks is None:
+            sinks = _sink_zeros(quant)
+        if sinks is not None:
+            variables["quant_sink"] = sinks
         out, updated = fn(
-            {"params": params, "quant": quant},
+            variables,
             micro["input_ids"],
             micro.get("attention_mask"),
             micro.get("token_type_ids"),
@@ -73,20 +115,45 @@ def _apply(state: TrainState, params, micro, dropout_rng, quant=None,
     )
 
 
-def calibrate_quant(state: TrainState, micro) -> TrainState:
+def calibrate_quant(state: TrainState, micro, *,
+                    objective: str = "classification",
+                    loss_scale: float = 1.0) -> TrainState:
     """Populate delayed-int8 amaxes from ONE real microbatch (step-0 scales).
 
     Delayed scaling quantizes with the previous microbatch's amax; before
     the first step there is none (init observed a dummy batch of ones), so
     run one deterministic forward with the quant collection mutable and keep
-    the observed amaxes. No-op for models without delayed quant."""
+    the observed amaxes. With delayed GRADIENT scaling
+    (``quant_delayed_grads``) one backward also runs, reading the dy
+    amaxes out of the sink gradients; ``loss_scale`` should match the
+    training step's per-microbatch loss scaling (1/grad_accum_steps) so
+    the calibrated dy magnitudes match what training's backward sees.
+    No-op for models without delayed quant."""
     if state.quant is None:
         return state
 
     def _cal(st, m):
-        return _apply(st, st.params, m, None, st.quant)[1]
+        q = _apply(st, st.params, m, None, st.quant)[1]
+        sinks0 = _sink_zeros(q)
+        if sinks0 is not None:
+            forward_loss = _LOSS_FNS[objective]
 
-    new_q = jax.jit(_cal)(state, micro)
+            def f(sinks):
+                loss, _ = forward_loss(st, st.params, m, None, q,
+                                       sinks=sinks)
+                return loss * loss_scale
+
+            q = _merge_dy_amaxes(q, jax.grad(f)(sinks0))
+        return q
+
+    from pytorch_distributed_training_tpu.ops.quant import dy_calibration_mode
+
+    with dy_calibration_mode():
+        # trace-time switch: the calibration backward quantizes dy with
+        # fresh DYNAMIC scales — with zero carried amaxes every
+        # downstream site would otherwise differentiate through saturated
+        # garbage cotangents and record garbage observations
+        new_q = jax.jit(_cal)(state, micro)
     # keep every amax leaf on its ORIGINAL sharding: under the pipeline
     # policies the [num_layers] dim is stage-sharded, and the train step's
     # in_shardings reject the jit default (replicated) placement
@@ -103,9 +170,11 @@ def calibrate_quant(state: TrainState, micro) -> TrainState:
 
 
 def _classification_loss(state: TrainState, params, micro, dropout_rng,
-                         quant=None):
+                         quant=None, sinks=None):
     """Mean masked softmax-CE over one microbatch, in fp32."""
-    logits, new_quant = _apply(state, params, micro, dropout_rng, quant)
+    logits, new_quant = _apply(
+        state, params, micro, dropout_rng, quant, sinks=sinks
+    )
     labels = micro["labels"]
     valid = micro.get("valid")
     if valid is None:
@@ -144,9 +213,11 @@ def _lm_shift_and_mask(micro):
 
 
 def _causal_lm_loss(state: TrainState, params, micro, dropout_rng,
-                    quant=None):
+                    quant=None, sinks=None):
     """Mean next-token CE per valid target position, in fp32."""
-    logits, new_quant = _apply(state, params, micro, dropout_rng, quant)
+    logits, new_quant = _apply(
+        state, params, micro, dropout_rng, quant, sinks=sinks
+    )
     targets, mask = _lm_shift_and_mask(micro)
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets
@@ -207,16 +278,36 @@ def make_train_step(
         def micro_grads(carry, micro):
             grads_acc, loss_acc, quant = carry
             step_rng = jax.random.fold_in(base_rng, loss_acc[1].astype(jnp.int32))
+            sinks0 = _sink_zeros(quant)
 
-            def loss_fn(p):
-                loss, (_, new_quant) = forward_loss(
-                    state, p, micro, step_rng, quant
-                )
-                return loss * inv_accum, new_quant
+            if sinks0 is not None:
+                # delayed dy scaling: the sinks' GRADIENTS are the
+                # backward's observed dy amaxes (ops/quant.py) — read
+                # them out and carry them with the fwd amaxes. The dy
+                # observed here includes the 1/accum loss scaling, which
+                # is exactly the magnitude next microbatch's backward
+                # sees, so the carried scale is self-consistent.
+                def loss_fn(p, sinks):
+                    loss, (_, new_quant) = forward_loss(
+                        state, p, micro, step_rng, quant, sinks=sinks
+                    )
+                    return loss * inv_accum, new_quant
 
-            (loss, new_quant), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
+                (loss, new_quant), (grads, sink_grads) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True
+                )(state.params, sinks0)
+                new_quant = _merge_dy_amaxes(new_quant, sink_grads)
+            else:
+
+                def loss_fn(p):
+                    loss, (_, new_quant) = forward_loss(
+                        state, p, micro, step_rng, quant
+                    )
+                    return loss * inv_accum, new_quant
+
+                (loss, new_quant), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params)
             grads = jax.tree.map(
                 lambda a, g: a + g.astype(acc_dtype), grads_acc, grads
             )

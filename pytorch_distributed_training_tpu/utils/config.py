@@ -112,6 +112,13 @@ class ModelConfig:
     # bench do it on the first real batch). Only read when matmul_impl is
     # int8/int8_full; unsupported under the GPipe pipeline trainer.
     quant_delayed: bool = False
+    # Extends quant_delayed to the BACKWARD's dy quantization (full mode):
+    # dy amaxes carried one microbatch late, removing the backward's two
+    # per-site absmax serializations. The observations leave the backward
+    # through a cotangent sink (ops/quant.py int8_dense_delayed_grads);
+    # supported by the standard train step only (not the pipeline
+    # schedules). Requires quant_delayed and dy calibration before step 0.
+    quant_delayed_grads: bool = False
     # Dropout mask generator (ops/dropout.py): "kernel" draws the keep mask
     # from the per-core TPU PRNG inside a Pallas op (only the x-dtype
     # mask-scale tensor touches HBM; falls back to bits32 off-TPU);

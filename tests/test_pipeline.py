@@ -491,9 +491,11 @@ def test_1f1b_step_matches_standard_step_at_dropout0(eight_devices):
 
 def test_pipeline_rejects_unsupported_configs(eight_devices):
     """Clear ValueErrors for the combos the pipeline trunks cannot run
-    (1F1B needs the stacked layer dim) — instead of deep flax/KeyError
-    failures."""
+    (1F1B needs the stacked layer dim; the delayed-GRADIENT sink channel
+    is not threaded through the schedules) — instead of deep
+    flax/KeyError failures."""
     from pytorch_distributed_training_tpu.parallel.pipeline import (
+        GPipeClassifier,
         make_1f1b_train_step,
     )
 
@@ -502,6 +504,14 @@ def test_pipeline_rejects_unsupported_configs(eight_devices):
         make_1f1b_train_step(
             model_preset("tiny"), mesh, None, n_micro=2, grad_accum_steps=1
         )
+    dgcfg = model_preset(
+        "tiny", scan_layers=True, matmul_impl="int8_full",
+        quant_delayed=True, quant_delayed_grads=True,
+    )
+    with pytest.raises(ValueError, match="quant_delayed_grads"):
+        GPipeClassifier(dgcfg, mesh, n_micro=2)
+    with pytest.raises(ValueError, match="quant_delayed_grads"):
+        make_1f1b_train_step(dgcfg, mesh, None, n_micro=2, grad_accum_steps=1)
 
 
 @pytest.mark.slow
