@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
+    # apply before anything logs: bootstrap/mesh banners honor the format
+    # (--log-format json; --metrics-dir enables the telemetry JSONL stream)
+    from pytorch_distributed_training_tpu.utils.logging import set_log_format
+
+    set_log_format(args.log_format)
     if args.quant_delayed and args.matmul_impl == "native":
         # silent no-op otherwise: dense_general only reads quant_delayed on
         # the int8 path, and a mislabeled A/B artifact is worse than an error

@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
+    from pytorch_distributed_training_tpu.utils.logging import set_log_format
+
+    set_log_format(args.log_format)
     tcfg = dataclass_from_args(TrainConfig, args)
     from pytorch_distributed_training_tpu.cli import resolve_attention
 

@@ -34,6 +34,7 @@ by then, keeping the release off the critical path). Integer datasets only
 from __future__ import annotations
 
 import ctypes
+import time
 from typing import Iterator
 
 import jax
@@ -43,6 +44,7 @@ from jax.sharding import Mesh
 from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
 from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
 from pytorch_distributed_training_tpu.native import load_batcher_lib
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 
 _RING_SLOTS = 4
 _WORKERS = 2
@@ -171,9 +173,14 @@ class NativeShardedLoader:
             jax.block_until_ready(placed)
             lib.batcher_release(self._handle, slot)
 
+        reg = get_registry()
         try:
             for step in range(n_steps):
+                # time the ring-slot wait: ~0 when the C++ workers are ahead
+                # of the device, the prefetch-stall signal when they're not
+                t0 = time.perf_counter()
                 slot = lib.batcher_next(self._handle, out_ptrs)
+                reg.observe("data/prefetch_wait_s", time.perf_counter() - t0)
                 if slot < 0:
                     break
                 batch = {}
@@ -182,6 +189,7 @@ class NativeShardedLoader:
                     n_el = self.accum * self._micro_local * self._row_elems[i]
                     buf = (ctypes.c_int32 * n_el).from_address(out_ptrs[i])
                     batch[k] = np.frombuffer(buf, np.int32).reshape(shape)
+                t_place = time.perf_counter()
                 if self.train:
                     placed = make_global_batch(
                         self.mesh, batch, pspec=TRAIN_BATCH_PSPEC
@@ -200,6 +208,9 @@ class NativeShardedLoader:
                     lo = self.pidx * self._micro_local
                     batch["valid"] = valid_global[lo : lo + self._micro_local]
                     placed = make_global_batch(self.mesh, batch)
+                reg.observe(
+                    "data/h2d_place_s", time.perf_counter() - t_place
+                )
                 yield placed
                 held.append((slot, placed))
                 if len(held) > 2:  # normally a no-op sync by now

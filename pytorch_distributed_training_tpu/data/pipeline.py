@@ -21,6 +21,7 @@ a TPU-shaped design:
 from __future__ import annotations
 
 import math
+import time
 from typing import Iterator
 
 import jax
@@ -29,6 +30,7 @@ from jax.sharding import Mesh
 
 from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
 from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC, dp_degree
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 
 
 def resolve_batch_geometry(
@@ -131,16 +133,24 @@ class ShardedLoader:
         perm = rng.permutation(self.n)
         micro_global = self.global_batch // self.accum
         micro_local = micro_global // self.pcount
+        reg = get_registry()
         for step in range(self.steps_per_epoch):
+            t0 = time.perf_counter()
             idx = perm[step * self.global_batch : (step + 1) * self.global_batch]
             idx = idx.reshape(self.accum, micro_global)
             local = idx[:, self.pidx * micro_local : (self.pidx + 1) * micro_local]
             batch = {k: v[local] for k, v in self.data.items()}
-            yield make_global_batch(self.mesh, batch, pspec=TRAIN_BATCH_PSPEC)
+            t1 = time.perf_counter()
+            placed = make_global_batch(self.mesh, batch, pspec=TRAIN_BATCH_PSPEC)
+            reg.observe("data/host_assemble_s", t1 - t0)
+            reg.observe("data/h2d_place_s", time.perf_counter() - t1)
+            yield placed
 
     def _eval_epoch(self) -> Iterator[dict]:
         per_host = self.local_per_step
+        reg = get_registry()
         for step in range(self.steps_per_epoch):
+            t0 = time.perf_counter()
             lo = step * self.global_batch
             idx_global = np.arange(lo, min(lo + self.global_batch, self.n))
             valid_n = len(idx_global)
@@ -155,4 +165,8 @@ class ShardedLoader:
             batch["valid"] = valid_global[
                 self.pidx * per_host : (self.pidx + 1) * per_host
             ]
-            yield make_global_batch(self.mesh, batch)
+            t1 = time.perf_counter()
+            placed = make_global_batch(self.mesh, batch)
+            reg.observe("data/eval_assemble_s", t1 - t0)
+            reg.observe("data/h2d_place_s", time.perf_counter() - t1)
+            yield placed

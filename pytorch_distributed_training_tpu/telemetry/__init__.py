@@ -1,0 +1,39 @@
+"""Structured telemetry: metric registry, JSONL sink, straggler detection.
+
+The three pieces, wired together by the Trainer (train/loop.py):
+
+- ``MetricsRegistry`` (registry.py) — counters/gauges/timer histograms that
+  instrumentation sites record into; a process-wide default registry lets
+  loaders, the checkpointer and the supervisor instrument without plumbing;
+- ``JsonlSink`` (sink.py) — process-0-gated append-only JSONL stream
+  (``--metrics-dir``): run-metadata header, per-step timing breakdown,
+  per-epoch records, checkpoint/restart events;
+- ``epoch_straggler_stats`` (straggler.py) — cross-host step-time gather so
+  process 0 can name the slowest host instead of just a slow fleet.
+
+``scripts/summarize_metrics.py`` folds a stream back into a per-epoch table.
+"""
+
+from pytorch_distributed_training_tpu.telemetry.registry import (
+    MetricsRegistry,
+    TimerStat,
+    get_registry,
+    set_registry,
+)
+from pytorch_distributed_training_tpu.telemetry.sink import (
+    JsonlSink,
+    run_metadata,
+)
+from pytorch_distributed_training_tpu.telemetry.straggler import (
+    epoch_straggler_stats,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "TimerStat",
+    "JsonlSink",
+    "run_metadata",
+    "epoch_straggler_stats",
+    "get_registry",
+    "set_registry",
+]

@@ -1,0 +1,102 @@
+"""Process-0-gated JSONL metrics sink + the run-metadata header.
+
+One record per line, appended and flushed as they happen, so a crashed or
+preempted run leaves a readable stream up to its last completed step — the
+machine-readable replacement for hand-assembling BENCH_*/HISTORY_* artifacts
+from rank-0 prints. Record types written by the framework:
+
+- ``run_meta``   — one header per (re)started run: mesh shape, chip/process
+                   counts, jax version, the fully-resolved model/train config;
+- ``step``       — per-step timing breakdown (data wait, dispatch, device
+                   block) + loss; ``compile_inclusive`` marks the first step;
+- ``epoch``      — the Trainer's history record + straggler stats + the
+                   epoch's timer summaries (checkpoint/loader/eval timings);
+- ``checkpoint_save`` / ``checkpoint_restore`` / ``restart`` — events.
+
+Every record gains a ``ts`` wall-clock field at write time. The file opens
+in append mode: a supervised restart (utils/supervisor.py) continues the
+same stream, with a fresh ``run_meta`` header marking the attempt boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any
+
+import jax
+
+
+def _jsonable(x: Any):
+    """Best-effort coercion for config values (paths, numpy scalars)."""
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    if isinstance(x, dict):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+class JsonlSink:
+    """Append-mode JSONL writer, active on process 0 only.
+
+    Construct it on every process — non-0 processes get an inert sink, so
+    call sites (checkpointer, supervisor, loaders) never branch on rank.
+    """
+
+    def __init__(
+        self,
+        metrics_dir: str,
+        *,
+        filename: str = "metrics.jsonl",
+        process_index: int | None = None,
+    ):
+        pidx = jax.process_index() if process_index is None else process_index
+        self._file = None
+        self.path = os.path.join(os.path.abspath(metrics_dir), filename)
+        if pidx == 0:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._file = open(self.path, "a")
+
+    @property
+    def active(self) -> bool:
+        return self._file is not None
+
+    def emit(self, record: dict) -> None:
+        if self._file is None:
+            return
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        self._file.write(json.dumps(_jsonable(rec)) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def run_metadata(mesh, model_config=None, train_config=None, **extra) -> dict:
+    """The ``run_meta`` header record: everything needed to interpret the
+    stream without the launching shell — mesh shape, chip count, resolved
+    configs, jax version."""
+    rec = {
+        "record": "run_meta",
+        "mesh_shape": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "chip_count": len(mesh.devices.flat),
+        "process_count": jax.process_count(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "config": {},
+    }
+    for key, cfg in (("model", model_config), ("train", train_config)):
+        if cfg is not None:
+            rec["config"][key] = _jsonable(dataclasses.asdict(cfg))
+    rec.update(extra)
+    return rec

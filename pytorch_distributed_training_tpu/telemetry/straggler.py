@@ -1,0 +1,69 @@
+"""Cross-host straggler detection from per-host step-time samples.
+
+On a multi-host run every step is a barrier: the global batch ships as one
+sharded array and the gradient psum can't complete until the slowest host
+has dispatched. A host that assembles batches slowly (cold page cache, a
+noisy neighbor, a dying NIC) therefore taxes EVERY host's step time, and
+rank-0's own wall clock can't tell which host it was. At each epoch
+boundary the Trainer all-gathers per-host step-time stats over the existing
+host collectives (comms/collectives.py — the same ``process_allgather``
+path the eval metrics ride) and process 0 reports the slowest host and the
+skew: ``wait_skew_s`` is how much mean step time the fleet would shed if
+the slowest host matched the fastest — the number that says "fix host k"
+instead of "the run is slow".
+
+Single-process runs degrade to a report over host 0 alone (skew 0), so the
+epoch record schema is identical everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.comms.collectives import host_allgather
+
+# per-host stat vector layout: [mean, max, min, count, data_wait_mean]
+_STAT_WIDTH = 5
+
+
+def epoch_straggler_stats(
+    step_times: Sequence[float],
+    data_waits: Sequence[float] | None = None,
+) -> dict:
+    """All-gather this host's step-time stats; return the fleet summary.
+
+    Collective: every process must call this the same number of times per
+    epoch (the Trainer calls it exactly once, at the epoch boundary —
+    the same cadence contract the eval metric gather already obeys).
+    """
+    st = np.asarray(step_times, np.float64)
+    dw = np.asarray(
+        data_waits if data_waits is not None else [], np.float64
+    )
+    local = np.array(
+        [
+            st.mean() if st.size else 0.0,
+            st.max() if st.size else 0.0,
+            st.min() if st.size else 0.0,
+            float(st.size),
+            dw.mean() if dw.size else 0.0,
+        ],
+        np.float64,
+    )
+    gathered = host_allgather(local).reshape(-1, _STAT_WIDTH)
+    means = gathered[:, 0]
+    slowest = int(np.argmax(means))
+    fastest = int(np.argmin(means))
+    return {
+        "hosts": int(gathered.shape[0]),
+        "slowest_host": slowest,
+        "slowest_host_mean_step_s": float(means[slowest]),
+        "fastest_host": fastest,
+        "fastest_host_mean_step_s": float(means[fastest]),
+        "wait_skew_s": float(means[slowest] - means[fastest]),
+        "slowest_host_max_step_s": float(gathered[slowest, 1]),
+        "slowest_host_data_wait_mean_s": float(gathered[slowest, 4]),
+        "per_host_mean_step_s": [float(m) for m in means],
+    }

@@ -21,11 +21,13 @@ crashing mid-resume.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 from pytorch_distributed_training_tpu.train.state import TrainState
 from pytorch_distributed_training_tpu.utils.logging import log0
 
@@ -131,14 +133,28 @@ class Checkpointer:
 
     def save(self, state: TrainState) -> str:
         step = int(jax.device_get(state.step))
+        t0 = time.perf_counter()
         self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
+        submit_s = time.perf_counter() - t0
+        reg = get_registry()
+        reg.inc("checkpoint/saves")
+        # submit time = what the training loop actually pays (orbax
+        # serializes asynchronously; the join is timed at wait/close)
+        reg.observe("checkpoint/save_submit_s", submit_s)
+        reg.emit({
+            "record": "checkpoint_save",
+            "step": step,
+            "submit_s": submit_s,
+            "path": os.path.join(self.directory, str(step)),
+        })
         log0(f"checkpoint saving: {self.directory}/{step}")
         return os.path.join(self.directory, str(step))
 
     def wait(self) -> None:
         """Join any in-flight async save (fault-injection and tests; a
         normal run only joins at ``close()``)."""
-        self._mngr.wait_until_finished()
+        with get_registry().timer("checkpoint/join_s"):
+            self._mngr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -147,7 +163,17 @@ class Checkpointer:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        t0 = time.perf_counter()
         restored = _restore_standard(self._mngr, step, state)
+        restore_s = time.perf_counter() - t0
+        reg = get_registry()
+        reg.observe("checkpoint/restore_s", restore_s)
+        reg.emit({
+            "record": "checkpoint_restore",
+            "step": step,
+            "restore_s": restore_s,
+            "path": os.path.join(self.directory, str(step)),
+        })
         log0(f"checkpoint restored: {self.directory}/{step}")
         return _merge_restored(state, dict(restored))
 
@@ -161,11 +187,13 @@ def save_checkpoint(directory: str, state: TrainState, *, keep: int = 3) -> str:
     ``Checkpointer`` inside training loops)."""
     directory = os.path.abspath(directory)
     step = int(jax.device_get(state.step))
+    t0 = time.perf_counter()
     with ocp.CheckpointManager(
         directory, options=ocp.CheckpointManagerOptions(max_to_keep=keep)
     ) as mngr:
         mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
         mngr.wait_until_finished()
+    get_registry().observe("checkpoint/save_s", time.perf_counter() - t0)
     log0(f"checkpoint saved: {directory}/{step}")
     return os.path.join(directory, str(step))
 

@@ -67,7 +67,12 @@ def scale_by_adam_fused(
 
     def update(updates, state, params=None):
         del params
-        count_inc = optax.safe_increment(state.count)
+        # optax renamed safe_int32_increment -> safe_increment; accept both
+        # so the optimizer works across the versions this image may carry
+        _safe_inc = getattr(
+            optax, "safe_increment", None
+        ) or optax.safe_int32_increment
+        count_inc = _safe_inc(state.count)
         # integer-exponent pow, exactly as optax's bias_correction computes
         # it (an explicit float cast here costs a ulp vs optax)
         b1c = 1 - b1 ** count_inc

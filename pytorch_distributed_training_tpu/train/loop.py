@@ -34,12 +34,19 @@ from pytorch_distributed_training_tpu.train.metrics import MetricAccumulator
 from pytorch_distributed_training_tpu.train.optim import adamw_with_schedule
 from pytorch_distributed_training_tpu.train.state import create_train_state
 from pytorch_distributed_training_tpu.train.step import make_eval_step, make_train_step
+from pytorch_distributed_training_tpu.telemetry import (
+    JsonlSink,
+    MetricsRegistry,
+    epoch_straggler_stats,
+    run_metadata,
+    set_registry,
+)
 from pytorch_distributed_training_tpu.utils.config import (
     MeshConfig,
     ModelConfig,
     TrainConfig,
 )
-from pytorch_distributed_training_tpu.utils.logging import log0
+from pytorch_distributed_training_tpu.utils.logging import log0, set_log_format
 from pytorch_distributed_training_tpu.utils.profiling import (
     annotate,
     maybe_profile,
@@ -73,6 +80,23 @@ class Trainer:
         )
 
         set_kernel_mesh(self.mesh)
+
+        # ------------------------------------------------------- telemetry
+        # Installed before data/checkpoint construction so every layer that
+        # records through the default registry (loaders, checkpointer,
+        # supervisor) lands in THIS run's window. The JSONL sink is built on
+        # every process but writes on process 0 only (telemetry/sink.py);
+        # the run-metadata header is emitted at the end of __init__, once
+        # the resolved geometry (steps_per_epoch) is known.
+        set_log_format(train_config.log_format)
+        self.registry = MetricsRegistry()
+        set_registry(self.registry)
+        self.metrics_sink = None
+        self._first_step_done = False
+        if train_config.metrics_dir:
+            self.metrics_sink = JsonlSink(train_config.metrics_dir)
+            self.registry.attach_sink(self.metrics_sink)
+
         self.policy = policy or ShardingPolicy()
         if model is None and model_factory is not None:
             # mesh-dependent models (e.g. the GPipe pipeline classifier,
@@ -300,6 +324,14 @@ class Trainer:
             apply_fn=getattr(self.model, "serial_apply", None),
         )
         self.history: list[dict] = []
+        if self.metrics_sink is not None:
+            self.metrics_sink.emit(
+                run_metadata(
+                    self.mesh, self.mcfg, train_config,
+                    steps_per_epoch=self.train_loader.steps_per_epoch,
+                    objective=self.objective,
+                )
+            )
 
     def _make_loader(self, data, train_config, *, train: bool):
         """ONE loader factory for both splits: the native C++ prefetching
@@ -357,6 +389,7 @@ class Trainer:
         from pytorch_distributed_training_tpu.comms.mesh import set_current_mesh
 
         set_current_mesh(self.mesh)  # ring attention retraces resolve to OUR mesh
+        set_registry(self.registry)  # layers record into OUR window/sink
         cfg = self.tcfg
         n_chips = self.info.global_device_count
         spe = max(self.train_loader.steps_per_epoch, 1)
@@ -385,14 +418,28 @@ class Trainer:
                 close = getattr(loader, "close", None)
                 if close:
                     close()
+        # Closed on the CLEAN path only: after a crash the stream stays open
+        # (every record is already flushed) so the supervisor's restart event
+        # and the next attempt's header append to the same file.
+        if self.metrics_sink is not None:
+            self.metrics_sink.close()
         return self.history
 
     def _run_epochs(self, cfg, n_chips, start_epoch, skip_in_first_epoch):
+        # Per-step telemetry (metrics_dir set) synchronizes on each step's
+        # loss so data-wait / dispatch / device-block attribution is honest;
+        # without it the loop keeps today's fully-async dispatch and only
+        # wall-clock step times (backpressure-accurate in steady state) are
+        # collected for the epoch-boundary straggler gather.
+        per_step = bool(cfg.metrics_dir)
+        reg = self.registry
         with maybe_profile(cfg.profile_dir):
             for epoch in range(start_epoch, cfg.num_epochs):
                 epoch_t0 = time.perf_counter()
                 samples = 0
                 losses = []
+                step_times: list[float] = []
+                data_waits: list[float] = []
                 # plain host-side counter mirrors state.step (one increment
                 # per train_step) — reading state.step back would force a
                 # host-device sync every step and serialize dispatch
@@ -408,9 +455,13 @@ class Trainer:
                         f"chain configuration?"
                     )
                 buf = []
+                t_prev = time.perf_counter()
                 for i, batch in enumerate(self.train_loader.epoch(epoch)):
+                    t_batch = time.perf_counter()
+                    data_wait = t_batch - t_prev
                     if i < skip:
                         step_no += 1
+                        t_prev = time.perf_counter()
                         continue
                     if chain > 1:
                         # ONE dispatch per chain_steps updates: stack the
@@ -425,11 +476,37 @@ class Trainer:
                             lambda *xs: jnp.stack(xs), *buf
                         )
                         buf.clear()
+                    compile_inclusive = not self._first_step_done
                     with annotate("train_step"):
                         self.state, metrics = self.train_step(self.state, batch)
+                    self._first_step_done = True
+                    t_dispatched = time.perf_counter()
+                    if per_step:
+                        # join this step so device_block_s is real device
+                        # time, not queue depth
+                        jax.block_until_ready(metrics["loss"])
+                    t_done = time.perf_counter()
                     samples += cfg.global_batch_size * chain
                     losses.append(metrics["loss"])
                     step_no += chain
+                    step_times.append(t_done - t_prev)
+                    data_waits.append(data_wait)
+                    reg.observe("train/data_wait_s", data_wait)
+                    if per_step:
+                        reg.observe("train/dispatch_s", t_dispatched - t_batch)
+                        reg.observe("train/device_block_s", t_done - t_dispatched)
+                        reg.observe("train/step_s", t_done - t_prev)
+                        reg.emit({
+                            "record": "step",
+                            "epoch": epoch,
+                            "step": step_no,
+                            "data_wait_s": data_wait,
+                            "dispatch_s": t_dispatched - t_batch,
+                            "device_block_s": t_done - t_dispatched,
+                            "step_s": t_done - t_prev,
+                            "loss": float(jax.device_get(metrics["loss"])),
+                            "compile_inclusive": compile_inclusive,
+                        })
                     if cfg.log_every and (
                         step_no // cfg.log_every
                         > (step_no - chain) // cfg.log_every
@@ -468,14 +545,19 @@ class Trainer:
                             flush=True,
                         )
                         _os._exit(13)
+                    t_prev = time.perf_counter()
                 jax.block_until_ready(self.state.params)
                 train_time = time.perf_counter() - epoch_t0
+                # every host contributes its step-time stats; process 0's
+                # epoch record then names the slowest host (telemetry/
+                # straggler.py) — a collective, same cadence as eval
+                straggler = epoch_straggler_stats(step_times, data_waits)
                 eval_metrics = self.evaluate()
                 record = {
                     "epoch": epoch,
-                    "train_loss": float(
-                        np.mean([float(jax.device_get(l)) for l in losses])
-                    )
+                    # ONE transfer for the whole epoch's losses (not one
+                    # device_get per step)
+                    "train_loss": float(np.mean(jax.device_get(losses)))
                     if losses
                     else float("nan"),
                     "samples_per_sec": samples / train_time,
@@ -486,6 +568,15 @@ class Trainer:
                 log0(f"epoch {epoch}: {record}")
                 if self.checkpointer:
                     self.checkpointer.save(self.state)
+                # epoch record last, so the checkpoint-save submit and eval
+                # wall time land inside this epoch's telemetry window
+                reg.emit({
+                    "record": "epoch",
+                    **record,
+                    "train_wall_s": train_time,
+                    "straggler": straggler,
+                    "telemetry": reg.snapshot(reset=True),
+                })
 
     @property
     def eval_loader(self):
@@ -494,6 +585,7 @@ class Trainer:
         return next(iter(self.eval_loaders.values()))
 
     def evaluate(self) -> dict:
+        eval_t0 = time.perf_counter()
         out = {}
         for suffix, loader in self.eval_loaders.items():
             if self.objective == "causal_lm":
@@ -516,4 +608,5 @@ class Trainer:
             out.update(
                 {f"{k}_{suffix}": v for k, v in raw.items()} if suffix else raw
             )
+        self.registry.observe("eval/wall_s", time.perf_counter() - eval_t0)
         return out

@@ -335,6 +335,17 @@ class TrainConfig:
     crash_rank: int = 0
     profile_dir: str | None = None  # enable jax.profiler traces when set
     debug_nans: bool = False
+    # Structured telemetry (telemetry/): when set, process 0 appends a JSONL
+    # stream under this directory — run-metadata header, per-step timing
+    # breakdown (data wait / dispatch / device block), per-epoch records
+    # with cross-host straggler stats, checkpoint/restart events. Fold it
+    # into a table with scripts/summarize_metrics.py. Per-step records
+    # synchronize on each step's loss (honest device-time attribution costs
+    # the async-dispatch overlap); leave unset for maximum throughput.
+    metrics_dir: str | None = None
+    # "text" | "json": json switches the framework loggers to one-JSON-
+    # object-per-line records (machine-scrapable multi-host logs).
+    log_format: str = "text"
     # Train-batch assembly engine: "auto" uses the native C++ prefetching
     # batcher (native/src/batcher.cpp) when a toolchain is available, else
     # the Python loader; "on" requires it; "off" forces the Python loader.

@@ -21,6 +21,7 @@ from __future__ import annotations
 import time
 from typing import Callable, TypeVar
 
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 from pytorch_distributed_training_tpu.utils.logging import log0
 
 T = TypeVar("T")
@@ -54,6 +55,19 @@ def run_with_restarts(
         except Exception as e:
             if on_failure is not None:
                 on_failure(attempt, e)
+            # the failed attempt's registry/sink are still installed (the
+            # Trainer leaves the stream open on a crash), so the restart
+            # event lands in the same metrics JSONL the attempt was writing
+            reg = get_registry()
+            if attempt < max_restarts:
+                reg.inc("supervisor/restarts")
+            reg.emit({
+                "record": "restart",
+                "attempt": attempt,
+                "error": type(e).__name__,
+                "message": str(e)[:500],
+                "will_retry": attempt < max_restarts,
+            })
             if attempt >= max_restarts:
                 raise
             log0(

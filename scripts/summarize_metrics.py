@@ -1,0 +1,141 @@
+"""Fold a telemetry JSONL stream into a per-epoch table.
+
+Reads the stream written by ``--metrics-dir`` (telemetry/sink.py) and prints
+one row per epoch: throughput (samples/sec/chip), where the step time went
+(data-wait %), and which host was slowest — the questions every perf PR has
+so far answered by hand-assembling BENCH_*/HISTORY_* artifacts.
+
+    python scripts/summarize_metrics.py /path/to/metrics_dir
+    python scripts/summarize_metrics.py /path/to/metrics.jsonl --json
+
+``--json`` dumps the summary dict instead of the table (for scripts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a metrics JSONL file (or a directory holding metrics.jsonl);
+    skips unparseable lines (a torn final line from a crashed run) rather
+    than failing the whole summary."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: skipping unparseable line: {line[:80]}",
+                      file=sys.stderr)
+    return records
+
+
+def summarize(records: list[dict]) -> dict:
+    """Fold the stream into {run, epochs: [per-epoch rows]}."""
+    meta = next((r for r in records if r.get("record") == "run_meta"), {})
+    steps_by_epoch: dict[int, list[dict]] = {}
+    for r in records:
+        if r.get("record") == "step":
+            steps_by_epoch.setdefault(int(r.get("epoch", 0)), []).append(r)
+    saves = [r for r in records if r.get("record") == "checkpoint_save"]
+    restarts = [r for r in records if r.get("record") == "restart"]
+
+    epochs = []
+    for r in records:
+        if r.get("record") != "epoch":
+            continue
+        epoch = int(r.get("epoch", len(epochs)))
+        steps = steps_by_epoch.get(epoch, [])
+        total_step = sum(s.get("step_s", 0.0) for s in steps)
+        total_wait = sum(s.get("data_wait_s", 0.0) for s in steps)
+        straggler = r.get("straggler") or {}
+        row = {
+            "epoch": epoch,
+            "steps": len(steps),
+            "train_loss": r.get("train_loss"),
+            "samples_per_sec_per_chip": r.get("samples_per_sec_per_chip"),
+            "data_wait_pct": 100.0 * total_wait / total_step
+            if total_step
+            else None,
+            "slowest_host": straggler.get("slowest_host"),
+            "wait_skew_s": straggler.get("wait_skew_s"),
+            "accuracy": r.get("accuracy"),
+            "eval_loss": r.get("eval_loss"),
+        }
+        epochs.append(row)
+    return {
+        "run": {
+            "mesh_shape": meta.get("mesh_shape"),
+            "chip_count": meta.get("chip_count"),
+            "jax_version": meta.get("jax_version"),
+        },
+        "epochs": epochs,
+        "checkpoint_saves": len(saves),
+        "restarts": len(restarts),
+    }
+
+
+def _fmt(v, spec=".4g") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return format(v, spec)
+    return str(v)
+
+
+def render_table(summary: dict) -> str:
+    cols = [
+        ("epoch", "epoch"),
+        ("steps", "steps"),
+        ("train_loss", "loss"),
+        ("samples_per_sec_per_chip", "samp/s/chip"),
+        ("data_wait_pct", "data-wait %"),
+        ("slowest_host", "slow host"),
+        ("wait_skew_s", "skew s"),
+        ("accuracy", "acc"),
+    ]
+    rows = [[_fmt(e.get(k)) for k, _ in cols] for e in summary["epochs"]]
+    headers = [h for _, h in cols]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(h.rjust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    run = summary["run"]
+    lines.append(
+        f"mesh={run.get('mesh_shape')} chips={run.get('chip_count')} "
+        f"ckpt_saves={summary['checkpoint_saves']} "
+        f"restarts={summary['restarts']}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("path", help="metrics.jsonl file or its --metrics-dir")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as JSON instead of a table")
+    args = p.parse_args(argv)
+    summary = summarize(load_records(args.path))
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(render_table(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()
