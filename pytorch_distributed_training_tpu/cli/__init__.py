@@ -6,3 +6,58 @@ def resolve_attention(attention_arg, mesh_seq: int):
     default. Returns a model_preset override dict."""
     attention = attention_arg or ("ring" if mesh_seq > 1 else None)
     return {"attention_impl": attention} if attention else {}
+
+
+def add_restart_args(parser) -> None:
+    """The supervised-restart flags every train entry point shares."""
+    parser.add_argument(
+        "--max-restarts", type=int, default=0,
+        help="restart-from-checkpoint attempts after a crash (needs "
+             "--checkpoint-dir; sets resume on retries). A graceful "
+             "preemption (SIGTERM -> emergency checkpoint, exit 75) never "
+             "burns one of these.")
+    parser.add_argument(
+        "--restart-window-s", type=float, default=0.0,
+        help="make the restart budget sliding: --max-restarts within this "
+             "many seconds (older restarts expire) — long runs survive "
+             "occasional failures without granting a crash loop unlimited "
+             "retries. 0 = lifetime budget.")
+
+
+def run_supervised(args, tcfg, build_trainer):
+    """Validate the restart/resume contract and run ``build_trainer(cfg)
+    .run()`` under ``run_with_restarts`` — retries resume from the newest
+    VERIFIED checkpoint. Shared by all three train CLIs."""
+    import dataclasses
+
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    if args.max_restarts and not tcfg.checkpoint_dir:
+        raise SystemExit("--max-restarts needs --checkpoint-dir to resume from")
+    if args.max_restarts and not tcfg.resume:
+        # a retry resumes from the LATEST checkpoint in the dir — if an older
+        # run left one there, attempt 1+ would silently continue that run's
+        # trajectory instead of this one's
+        from pytorch_distributed_training_tpu.train.checkpoint import (
+            latest_step,
+        )
+
+        if latest_step(tcfg.checkpoint_dir) is not None:
+            raise SystemExit(
+                f"checkpoint dir {tcfg.checkpoint_dir!r} already holds a "
+                f"checkpoint; pass --resume to continue it or point "
+                f"--checkpoint-dir at a fresh directory"
+            )
+
+    def attempt(i: int):
+        cfg = dataclasses.replace(tcfg, resume=tcfg.resume or i > 0)
+        return build_trainer(cfg).run()
+
+    return run_with_restarts(
+        attempt,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window_s,
+        checkpoint_dir=tcfg.checkpoint_dir if args.max_restarts else None,
+    )

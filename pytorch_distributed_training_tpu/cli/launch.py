@@ -117,8 +117,18 @@ def main(argv=None) -> int:
                 remaining.discard(rank)
                 if p.returncode != 0:
                     rc = p.returncode
+                    # 75 = faults.preemption.RESUMABLE_EXIT_CODE: the rank
+                    # checkpointed and exited gracefully — relaunching with
+                    # --resume continues it; don't treat it as a crash
+                    note = (
+                        " (preempted: emergency checkpoint written, "
+                        "relaunch with --resume)"
+                        if p.returncode == 75
+                        else ""
+                    )
                     sys.stderr.write(
-                        f"[launch] rank {rank} exited with {p.returncode}; "
+                        f"[launch] rank {rank} exited with {p.returncode}"
+                        f"{note}; "
                         f"terminating {len(remaining)} remaining process(es)\n"
                     )
                     for other in remaining:

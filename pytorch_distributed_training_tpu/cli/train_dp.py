@@ -64,9 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-fsdp", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1,
                    help="context-parallel degree (ring attention)")
-    p.add_argument("--max-restarts", type=int, default=0,
-                   help="restart-from-checkpoint attempts after a crash "
-                        "(needs --checkpoint-dir; sets resume on retries)")
+    from pytorch_distributed_training_tpu.cli import add_restart_args
+
+    add_restart_args(p)
     p.add_argument("--hf-checkpoint", default=None,
                    help="HF torch checkpoint (dir or model id with local "
                         "cache) to start from — the reference's pretrained "
@@ -115,37 +115,15 @@ def main(argv=None) -> list[dict]:
         data=args.mesh_data, fsdp=args.mesh_fsdp, seq=args.mesh_seq
     )
     policy = ShardingPolicy(fsdp=args.fsdp)
-    if args.max_restarts and not tcfg.checkpoint_dir:
-        raise SystemExit("--max-restarts needs --checkpoint-dir to resume from")
-    if args.max_restarts and not tcfg.resume:
-        # a retry resumes from the LATEST checkpoint in the dir — if an older
-        # run left one there, attempt 1+ would silently continue that run's
-        # trajectory instead of this one's
-        from pytorch_distributed_training_tpu.train.checkpoint import (
-            latest_step,
-        )
+    from pytorch_distributed_training_tpu.cli import run_supervised
 
-        if latest_step(tcfg.checkpoint_dir) is not None:
-            raise SystemExit(
-                f"checkpoint dir {tcfg.checkpoint_dir!r} already holds a "
-                f"checkpoint; pass --resume to continue it or point "
-                f"--checkpoint-dir at a fresh directory"
-            )
-
-    def attempt(i: int):
-        import dataclasses
-
-        cfg = dataclasses.replace(tcfg, resume=tcfg.resume or i > 0)
-        return Trainer(
+    history = run_supervised(
+        args, tcfg,
+        lambda cfg: Trainer(
             mcfg, cfg, mesh_cfg, policy, task=args.task,
             hf_checkpoint=args.hf_checkpoint,
-        ).run()
-
-    from pytorch_distributed_training_tpu.utils.supervisor import (
-        run_with_restarts,
+        ),
     )
-
-    history = run_with_restarts(attempt, max_restarts=args.max_restarts)
     if args.history_out and __import__("jax").process_index() == 0:
         import json
 

@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1,
                    help="context-parallel degree (ring attention)")
+    from pytorch_distributed_training_tpu.cli import add_restart_args
+
+    add_restart_args(p)
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -92,8 +95,12 @@ def main(argv=None) -> list[dict]:
         seq=args.mesh_seq,
     )
     policy = ShardingPolicy(fsdp=args.fsdp, tp=args.tp)
-    trainer = Trainer(mcfg, tcfg, mesh_cfg, policy, task=args.task)
-    return trainer.run()
+    from pytorch_distributed_training_tpu.cli import run_supervised
+
+    return run_supervised(
+        args, tcfg,
+        lambda cfg: Trainer(mcfg, cfg, mesh_cfg, policy, task=args.task),
+    )
 
 
 if __name__ == "__main__":

@@ -80,6 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1,
                    help="context-parallel degree (ring attention)")
+    from pytorch_distributed_training_tpu.cli import add_restart_args
+
+    add_restart_args(p)
     add_dataclass_args(p, TrainConfig)
     return p
 
@@ -189,11 +192,16 @@ def main(argv=None) -> list[dict]:
                     accum_dtype=_t.grad_accum_dtype,
                 )
 
-    trainer = Trainer(
-        mcfg, tcfg, mesh_cfg, policy, task=args.task, model=model,
-        model_factory=model_factory, train_step_factory=train_step_factory,
+    from pytorch_distributed_training_tpu.cli import run_supervised
+
+    return run_supervised(
+        args, tcfg,
+        lambda cfg: Trainer(
+            mcfg, cfg, mesh_cfg, policy, task=args.task, model=model,
+            model_factory=model_factory,
+            train_step_factory=train_step_factory,
+        ),
     )
-    return trainer.run()
 
 
 if __name__ == "__main__":

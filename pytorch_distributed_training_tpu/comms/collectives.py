@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import multihost_utils
 
+from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
+
 
 # --------------------------------------------------------------------------
 # In-jit collectives (require a mapped axis: inside shard_map / vmap+axis).
@@ -62,8 +64,11 @@ def host_allgather(x: np.ndarray | jnp.ndarray) -> np.ndarray:
     if jax.process_count() == 1:
         return arr
     # process_allgather stacks a new leading axis; flatten it into dim 0 to
-    # match torch.distributed.all_gather + cat(dim=0).
-    gathered = multihost_utils.process_allgather(arr)
+    # match torch.distributed.all_gather + cat(dim=0). A dead/wedged peer
+    # blocks this forever — the watchdog (when a Trainer installed one)
+    # turns that into a stall record + supervised abort instead of a hang.
+    with watchdog_guard("host_allgather"):
+        gathered = multihost_utils.process_allgather(arr)
     return np.reshape(gathered, (-1,) + arr.shape[1:])
 
 
@@ -76,7 +81,8 @@ def broadcast_from_host0(tree):
     """Make process-0's value authoritative everywhere (config/seed sync)."""
     if jax.process_count() == 1:
         return tree
-    return multihost_utils.broadcast_one_to_all(tree)
+    with watchdog_guard("host_broadcast"):
+        return multihost_utils.broadcast_one_to_all(tree)
 
 
 def assert_same_across_hosts(tree, name: str = "value") -> None:
@@ -84,4 +90,7 @@ def assert_same_across_hosts(tree, name: str = "value") -> None:
     the 'consistent global batches' hazard, SURVEY.md §7 hard parts)."""
     if jax.process_count() == 1:
         return
-    multihost_utils.assert_equal(tree, fail_message=f"{name} differs across hosts")
+    with watchdog_guard("host_assert_equal"):
+        multihost_utils.assert_equal(
+            tree, fail_message=f"{name} differs across hosts"
+        )

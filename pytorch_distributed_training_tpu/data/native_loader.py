@@ -43,6 +43,7 @@ from jax.sharding import Mesh
 
 from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
 from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+from pytorch_distributed_training_tpu.faults.inject import get_plan
 from pytorch_distributed_training_tpu.native import load_batcher_lib
 from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 
@@ -211,6 +212,9 @@ class NativeShardedLoader:
                 reg.observe(
                     "data/h2d_place_s", time.perf_counter() - t_place
                 )
+                # fault injection (PDT_TPU_FAULT=slow_host:2x): stretch THIS
+                # host's batch path so straggler detection has a straggler
+                get_plan().slow_host_delay(time.perf_counter() - t0)
                 yield placed
                 held.append((slot, placed))
                 if len(held) > 2:  # normally a no-op sync by now

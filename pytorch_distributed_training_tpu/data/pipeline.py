@@ -30,6 +30,7 @@ from jax.sharding import Mesh
 
 from pytorch_distributed_training_tpu.comms.ingest import make_global_batch
 from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC, dp_degree
+from pytorch_distributed_training_tpu.faults.inject import get_plan
 from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 
 
@@ -144,6 +145,9 @@ class ShardedLoader:
             placed = make_global_batch(self.mesh, batch, pspec=TRAIN_BATCH_PSPEC)
             reg.observe("data/host_assemble_s", t1 - t0)
             reg.observe("data/h2d_place_s", time.perf_counter() - t1)
+            # fault injection (PDT_TPU_FAULT=slow_host:2x): stretch THIS
+            # host's batch work so straggler detection has a straggler
+            get_plan().slow_host_delay(time.perf_counter() - t0)
             yield placed
 
     def _eval_epoch(self) -> Iterator[dict]:
@@ -169,4 +173,5 @@ class ShardedLoader:
             placed = make_global_batch(self.mesh, batch)
             reg.observe("data/eval_assemble_s", t1 - t0)
             reg.observe("data/h2d_place_s", time.perf_counter() - t1)
+            get_plan().slow_host_delay(time.perf_counter() - t0)
             yield placed

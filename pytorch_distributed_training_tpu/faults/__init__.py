@@ -1,0 +1,58 @@
+"""Fault-tolerance subsystem: injection, preemption, and hung-step watchdog.
+
+The reference repo's entire failure story is crash propagation
+(``mp.spawn(..., join=True)`` re-raises and the run is over — SURVEY.md §5);
+``utils/supervisor.py`` supplies the restart half. This package supplies the
+rest of a production failure story:
+
+- ``inject``     — deterministic fault injection (``PDT_TPU_FAULT``):
+                   crash/SIGTERM/hang at a chosen step, checkpoint
+                   corruption, a slowed host — so every recovery path is
+                   exercised end-to-end in CPU-only tests;
+- ``preemption`` — SIGTERM/SIGINT → graceful stop at the next step boundary
+                   with an emergency checkpoint and a resumable exit code
+                   (``RESUMABLE_EXIT_CODE``) an external supervisor can
+                   recognize as "don't burn a restart";
+- ``watchdog``   — a monitor thread armed around device-blocking sections
+                   (step dispatch/block, checkpoint joins, host collectives)
+                   that records a ``watchdog_stall`` with stack dumps after a
+                   multiple of the rolling median step time, and aborts the
+                   process past a hard timeout so the supervisor restarts a
+                   hung job instead of waiting forever.
+"""
+
+from pytorch_distributed_training_tpu.faults.inject import (
+    FaultPlan,
+    InjectedCrash,
+    corrupt_step_dir,
+    get_plan,
+    set_plan,
+)
+from pytorch_distributed_training_tpu.faults.preemption import (
+    RESUMABLE_EXIT_CODE,
+    GracefulShutdown,
+    Preempted,
+)
+from pytorch_distributed_training_tpu.faults.watchdog import (
+    WATCHDOG_EXIT_CODE,
+    Watchdog,
+    get_watchdog,
+    set_watchdog,
+    watchdog_guard,
+)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedCrash",
+    "corrupt_step_dir",
+    "get_plan",
+    "set_plan",
+    "GracefulShutdown",
+    "Preempted",
+    "RESUMABLE_EXIT_CODE",
+    "Watchdog",
+    "WATCHDOG_EXIT_CODE",
+    "get_watchdog",
+    "set_watchdog",
+    "watchdog_guard",
+]

@@ -1,0 +1,237 @@
+"""Deterministic fault injection (``PDT_TPU_FAULT``).
+
+The recovery machinery in this repo — supervisor restarts, checkpoint
+verification + fallback, preemption-safe shutdown, the hung-step watchdog —
+is only a guarantee if every path is exercised end-to-end. This layer turns
+one environment variable into reproducible failures at exact points of a
+run, so CPU-only tests (and chaos drills on real pods) drive the real code
+paths instead of mocks.
+
+Syntax — comma-separated specs, each ``kind:arg`` with an optional ``@rank``
+(the process index that fires; default 0):
+
+- ``crash_at_step:7``    raise ``InjectedCrash`` right after update 7 — the
+                         supervisor-retryable failure (``run_with_restarts``
+                         catches it, restarts, resumes from checkpoint). For
+                         a hard ``os._exit`` kill (no python cleanup) use the
+                         ``--crash-at-step`` TrainConfig flag instead.
+- ``sigterm_at_step:5``  deliver SIGTERM to this process after update 5 —
+                         exercises the preemption path: emergency checkpoint,
+                         ``preemption`` telemetry record, resumable exit code.
+- ``hang_at_step:3``     block forever after update 3 inside a watchdog-
+                         guarded section — exercises stall detection + abort.
+- ``corrupt_ckpt:latest`` flip bytes in the newest committed checkpoint when
+                         the Checkpointer closes (``corrupt_ckpt:12`` targets
+                         step 12) — exercises manifest verification and the
+                         fall-back-to-verified-step restore.
+- ``slow_host:2x``       stretch this host's batch assembly by the given
+                         factor — exercises straggler detection without a
+                         slow machine.
+
+Every spec fires AT MOST ONCE per process (a restarted attempt inside the
+same process does not re-fire), so an injected crash converges to recovery
+instead of crash-looping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+ENV_VAR = "PDT_TPU_FAULT"
+
+_STEP_KINDS = ("crash_at_step", "sigterm_at_step", "hang_at_step")
+_KINDS = _STEP_KINDS + ("corrupt_ckpt", "slow_host")
+
+logger = get_logger(__name__)
+
+
+class InjectedCrash(RuntimeError):
+    """The deterministic stand-in for a dying host: raised at an exact step
+    boundary so the supervisor's catch→restart→resume loop runs for real."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    step: int = 0          # *_at_step kinds
+    target: str = ""       # corrupt_ckpt: "latest" or a step number
+    factor: float = 1.0    # slow_host
+    rank: int = 0          # process index that fires
+    fired: bool = False
+
+
+def _parse_spec(text: str) -> FaultSpec:
+    text = text.strip()
+    rank = 0
+    if "@" in text:
+        text, rank_s = text.rsplit("@", 1)
+        rank = int(rank_s)
+    if ":" not in text:
+        raise ValueError(f"fault spec {text!r} needs kind:arg")
+    kind, arg = text.split(":", 1)
+    if kind not in _KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; have {_KINDS}")
+    spec = FaultSpec(kind=kind, rank=rank)
+    if kind in _STEP_KINDS:
+        spec.step = int(arg)
+        if spec.step <= 0:
+            raise ValueError(f"{kind} needs a positive step, got {arg!r}")
+    elif kind == "corrupt_ckpt":
+        if arg != "latest" and not arg.isdigit():
+            raise ValueError(
+                f"corrupt_ckpt target must be 'latest' or a step, got {arg!r}"
+            )
+        spec.target = arg
+    else:  # slow_host
+        m = re.fullmatch(r"([0-9.]+)x?", arg)
+        if not m or float(m.group(1)) < 1.0:
+            raise ValueError(f"slow_host needs a factor >= 1 (e.g. 2x), got {arg!r}")
+        spec.factor = float(m.group(1))
+    return spec
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable here
+        return 0
+
+
+def _emit(record: dict) -> None:
+    from pytorch_distributed_training_tpu.telemetry.registry import get_registry
+
+    reg = get_registry()
+    reg.inc("faults/injected")
+    reg.emit({"record": "fault_injected", **record})
+
+
+class FaultPlan:
+    """The parsed, per-process fault schedule. Hooks are called from the
+    Trainer (step boundaries), the loaders (batch assembly) and the
+    Checkpointer (close) — each is a no-op when no matching spec is armed."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = specs
+
+    @classmethod
+    def parse(cls, text: str | None) -> "FaultPlan":
+        if not text or not text.strip():
+            return cls([])
+        return cls([_parse_spec(s) for s in text.split(",") if s.strip()])
+
+    def _take(self, kind: str, pred) -> FaultSpec | None:
+        """The first unfired spec of ``kind`` matching ``pred`` on this
+        process, marked fired."""
+        pidx = _process_index()
+        for spec in self.specs:
+            if (
+                spec.kind == kind
+                and not spec.fired
+                and spec.rank == pidx
+                and pred(spec)
+            ):
+                spec.fired = True
+                return spec
+        return None
+
+    # --------------------------------------------------------------- hooks
+
+    def fire_step_fault(self, step: int) -> None:
+        """Trainer hook, called right after completing update ``step``."""
+        spec = self._take("crash_at_step", lambda s: s.step == step)
+        if spec is not None:
+            _emit({"fault": "crash_at_step", "step": step})
+            raise InjectedCrash(f"injected crash after step {step}")
+        spec = self._take("sigterm_at_step", lambda s: s.step == step)
+        if spec is not None:
+            import signal
+
+            _emit({"fault": "sigterm_at_step", "step": step})
+            logger.warning("injecting SIGTERM after step %d", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        spec = self._take("hang_at_step", lambda s: s.step == step)
+        if spec is not None:
+            from pytorch_distributed_training_tpu.faults.watchdog import (
+                watchdog_guard,
+            )
+
+            _emit({"fault": "hang_at_step", "step": step})
+            logger.warning("injecting hang after step %d", step)
+            with watchdog_guard("injected_hang", step=step):
+                while True:  # a stuck collective never returns; nor do we —
+                    time.sleep(60)  # the watchdog's hard timeout ends this
+
+    def slow_host_delay(self, elapsed_s: float) -> None:
+        """Loader hook: stretch this host's batch work to ``factor`` × its
+        real duration (the spec stays armed — a straggler is slow on every
+        batch, not once)."""
+        pidx = _process_index()
+        for spec in self.specs:
+            if spec.kind == "slow_host" and spec.rank == pidx:
+                if not spec.fired:
+                    spec.fired = True  # record the injection once
+                    _emit({"fault": "slow_host", "factor": spec.factor})
+                time.sleep(max(0.0, elapsed_s) * (spec.factor - 1.0))
+                return
+
+    def corrupt_checkpoint_target(self) -> str | None:
+        """Checkpointer hook (at close): the step to corrupt, or None."""
+        spec = self._take("corrupt_ckpt", lambda s: True)
+        return spec.target if spec is not None else None
+
+
+def corrupt_step_dir(step_path: str, *, flip_bytes: int = 64) -> str:
+    """Corrupt a committed checkpoint step in place: overwrite the first
+    ``flip_bytes`` of its largest data file (same length — the failure mode
+    a size check alone cannot see). Returns the corrupted file's path."""
+    victim, size = None, -1
+    for root, _dirs, files in os.walk(step_path):
+        for name in files:
+            if name == "pdt_manifest.json":
+                continue
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                victim, size = p, s
+    if victim is None:
+        raise FileNotFoundError(f"no data files under {step_path}")
+    n = min(flip_bytes, size)
+    with open(victim, "r+b") as f:
+        head = f.read(n)
+        f.seek(0)
+        f.write(bytes(b ^ 0xFF for b in head))
+    logger.warning("corrupted %d bytes of %s", n, victim)
+    return victim
+
+
+_active: FaultPlan | None = None
+
+
+def get_plan() -> FaultPlan:
+    """The process-wide plan, parsed from ``PDT_TPU_FAULT`` once (so each
+    spec's fired-state survives supervisor restarts within the process)."""
+    global _active
+    if _active is None:
+        _active = FaultPlan.parse(os.environ.get(ENV_VAR))
+        if _active.specs:
+            logger.warning(
+                "fault injection armed: %s", os.environ.get(ENV_VAR)
+            )
+    return _active
+
+
+def set_plan(plan: FaultPlan | None) -> FaultPlan | None:
+    """Install ``plan`` (tests); returns the previous one. None re-arms
+    lazy parsing from the environment."""
+    global _active
+    prev = _active
+    _active = plan
+    return prev

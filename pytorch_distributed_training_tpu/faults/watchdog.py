@@ -1,0 +1,283 @@
+"""Hung-step watchdog: detect the failure mode that never raises.
+
+A dead host crashes; a WEDGED host — one rank stuck in a collective, a
+checkpoint join waiting on a filesystem that went away, a device queue that
+stopped draining — hangs every peer forever, and no exception ever reaches
+the supervisor. The watchdog is a monitor thread armed around each
+device-blocking section (step dispatch/block in ``train/loop.py``,
+checkpoint joins, host collectives in ``comms/collectives.py``):
+
+- after ``stall_factor`` × the rolling median duration of that section
+  (floored at ``min_stall_s``), it emits a ``watchdog_stall`` telemetry
+  record carrying every thread's stack — the post-mortem for "which
+  collective, called from where";
+- past ``hard_timeout_s`` it emits ``watchdog_abort``, flushes the telemetry
+  stream, and kills the process with ``WATCHDOG_EXIT_CODE`` so the
+  supervisor restarts from checkpoint instead of hanging until a human
+  notices.
+
+Sections that recover after a stall emit ``watchdog_recovered`` (a slow fs,
+a transient network partition) — stalls are evidence, aborts are policy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+#: Exit status of a watchdog abort — distinct from a crash (supervisors may
+#: log it differently) but still a failure: restart and burn a budget slot.
+WATCHDOG_EXIT_CODE = 84
+
+_STACK_LIMIT_CHARS = 8000
+
+logger = get_logger(__name__)
+
+
+def _all_stacks() -> str:
+    """Every thread's current stack, newest frame last (the hang evidence)."""
+    lines = []
+    frames = sys._current_frames()
+    for thread in threading.enumerate():
+        frame = frames.get(thread.ident)
+        if frame is None:
+            continue
+        lines.append(f"--- thread {thread.name} ({thread.ident}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    text = "\n".join(lines)
+    if len(text) > _STACK_LIMIT_CHARS:
+        text = text[-_STACK_LIMIT_CHARS:]
+    return text
+
+
+class Watchdog:
+    """One monitor thread per Trainer; ``guard`` is the only call site API.
+
+    ``hard_timeout_s=0`` disables the abort (stall records only).
+    ``_exit`` is injectable so tests can assert the abort without dying.
+    """
+
+    def __init__(
+        self,
+        *,
+        stall_factor: float = 10.0,
+        min_stall_s: float = 60.0,
+        hard_timeout_s: float = 1800.0,
+        _exit=os._exit,
+    ):
+        if stall_factor <= 0 or min_stall_s < 0 or hard_timeout_s < 0:
+            raise ValueError(
+                f"watchdog thresholds must be positive (stall_factor="
+                f"{stall_factor}, min_stall_s={min_stall_s}, "
+                f"hard_timeout_s={hard_timeout_s})"
+            )
+        self.stall_factor = stall_factor
+        self.min_stall_s = min_stall_s
+        self.hard_timeout_s = hard_timeout_s
+        self._exit = _exit
+        self._cond = threading.Condition()
+        self._armed: dict | None = None
+        self._closed = False
+        self._history: dict[str, deque] = {}
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ thresholds
+
+    def stall_after_s(self, what: str) -> float:
+        hist = self._history.get(what)
+        if not hist:
+            return self.min_stall_s
+        med = sorted(hist)[len(hist) // 2]
+        return max(self.min_stall_s, self.stall_factor * med)
+
+    def observe(self, what: str, seconds: float) -> None:
+        self._history.setdefault(what, deque(maxlen=32)).append(
+            float(seconds)
+        )
+
+    # ----------------------------------------------------------------- guard
+
+    @contextlib.contextmanager
+    def guard(self, what: str, *, step: int | None = None):
+        """Arm around a section that blocks on devices/peers/filesystems."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._monitor, name="pdt-watchdog", daemon=True
+            )
+            self._thread.start()
+        t0 = time.monotonic()
+        entry = {
+            "what": what,
+            "step": step,
+            "t0": t0,
+            "stall_deadline": t0 + self.stall_after_s(what),
+            "hard_deadline": (
+                t0 + self.hard_timeout_s if self.hard_timeout_s else None
+            ),
+            "stalled": False,
+        }
+        with self._cond:
+            self._armed = entry
+            self._cond.notify_all()
+        try:
+            yield
+        finally:
+            duration = time.monotonic() - t0
+            with self._cond:
+                stalled = entry["stalled"]
+                self._armed = None
+                self._cond.notify_all()
+            self.observe(what, duration)
+            if stalled:
+                self._emit({
+                    "record": "watchdog_recovered",
+                    "section": what,
+                    "step": step,
+                    "duration_s": duration,
+                })
+                logger.warning(
+                    "watchdog: section %r recovered after %.1fs", what,
+                    duration,
+                )
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # --------------------------------------------------------------- monitor
+
+    def _emit(self, record: dict) -> None:
+        from pytorch_distributed_training_tpu.telemetry.registry import (
+            get_registry,
+        )
+
+        get_registry().emit(record)
+
+    def _monitor(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                entry = self._armed
+                if entry is None:
+                    self._cond.wait()
+                    continue
+                now = time.monotonic()
+                fire_hard = (
+                    entry["hard_deadline"] is not None
+                    and now >= entry["hard_deadline"]
+                )
+                fire_stall = (
+                    not fire_hard
+                    and not entry["stalled"]
+                    and now >= entry["stall_deadline"]
+                )
+                if not (fire_hard or fire_stall):
+                    pending = [
+                        d
+                        for d in (
+                            None
+                            if entry["stalled"]
+                            else entry["stall_deadline"],
+                            entry["hard_deadline"],
+                        )
+                        if d is not None and d > now
+                    ]
+                    # no pending deadline (stalled, abort disabled): sleep
+                    # until the section disarms or a new one arms
+                    self._cond.wait(
+                        timeout=min(pending) - now if pending else None
+                    )
+                    continue
+                entry["stalled"] = True
+            waited = time.monotonic() - entry["t0"]
+            if not fire_hard:
+                self._emit({
+                    "record": "watchdog_stall",
+                    "section": entry["what"],
+                    "step": entry["step"],
+                    "waited_s": waited,
+                    "stall_after_s": self.stall_after_s(entry["what"]),
+                    "hard_timeout_s": self.hard_timeout_s,
+                    "stacks": _all_stacks(),
+                })
+                logger.error(
+                    "watchdog: section %r blocked for %.1fs (threshold "
+                    "%.1fs) — possible hung collective/device; stacks "
+                    "recorded%s",
+                    entry["what"], waited, self.stall_after_s(entry["what"]),
+                    f"; aborting at {self.hard_timeout_s:.0f}s"
+                    if self.hard_timeout_s else "",
+                )
+                continue
+            self._abort(entry, waited)
+            return
+
+    def _abort(self, entry: dict, waited: float) -> None:
+        from pytorch_distributed_training_tpu.telemetry.registry import (
+            get_registry,
+        )
+
+        reg = get_registry()
+        reg.emit({
+            "record": "watchdog_abort",
+            "section": entry["what"],
+            "step": entry["step"],
+            "waited_s": waited,
+            "hard_timeout_s": self.hard_timeout_s,
+            "exit_code": WATCHDOG_EXIT_CODE,
+            "stacks": _all_stacks(),
+        })
+        sink = reg.sink
+        if sink is not None:
+            try:
+                sink.flush(fsync=True)
+            except Exception:  # pragma: no cover - best-effort on the way out
+                pass
+        logger.critical(
+            "watchdog: section %r blocked for %.1fs > hard timeout %.1fs — "
+            "aborting (exit %d) so the supervisor can restart from "
+            "checkpoint",
+            entry["what"], waited, self.hard_timeout_s, WATCHDOG_EXIT_CODE,
+        )
+        self._exit(WATCHDOG_EXIT_CODE)
+
+
+_current: Watchdog | None = None
+
+
+def set_watchdog(watchdog: Watchdog | None) -> Watchdog | None:
+    """Install the process-wide watchdog (the Trainer, for its run); returns
+    the previous one so tests/nested runs can restore it."""
+    global _current
+    prev = _current
+    _current = watchdog
+    return prev
+
+
+def get_watchdog() -> Watchdog | None:
+    return _current
+
+
+@contextlib.contextmanager
+def watchdog_guard(what: str, *, step: int | None = None):
+    """Guard a blocking section under the installed watchdog, if any — the
+    zero-plumbing entry point for layers without a Trainer handle (host
+    collectives, checkpoint joins)."""
+    wd = _current
+    if wd is None:
+        yield
+    else:
+        with wd.guard(what, step=step):
+            yield

@@ -76,6 +76,16 @@ class JsonlSink:
         self._file.write(json.dumps(_jsonable(rec)) + "\n")
         self._file.flush()
 
+    def flush(self, *, fsync: bool = False) -> None:
+        """Push buffered records to the OS — and with ``fsync``, to disk.
+        The crash/preemption/watchdog exits call this so the last records
+        (the ones explaining the exit) survive the process."""
+        if self._file is None:
+            return
+        self._file.flush()
+        if fsync:
+            os.fsync(self._file.fileno())
+
     def close(self) -> None:
         if self._file is not None:
             self._file.close()

@@ -27,9 +27,16 @@ from typing import Optional
 import jax
 import orbax.checkpoint as ocp
 
+from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
 from pytorch_distributed_training_tpu.telemetry.registry import get_registry
+from pytorch_distributed_training_tpu.train import manifest as ckpt_manifest
 from pytorch_distributed_training_tpu.train.state import TrainState
 from pytorch_distributed_training_tpu.utils.logging import log0
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No step under the directory passed integrity verification (and the
+    directory is not a pre-manifest legacy one)."""
 
 _SAVEABLE = ("step", "params", "opt_state", "dropout_rng")
 _RNG_BUF_WORDS = 8  # fits every jax key impl (threefry 2, rbg/unsafe_rbg 4)
@@ -122,8 +129,17 @@ class Checkpointer:
     ``close()`` or when a newer save supersedes them.
     """
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3, verify: str = "size"):
+        if verify not in ckpt_manifest.VERIFY_LEVELS:
+            raise ValueError(
+                f"checkpoint verify level must be one of "
+                f"{ckpt_manifest.VERIFY_LEVELS}, got {verify!r}"
+            )
         self.directory = os.path.abspath(directory)
+        self.verify = verify
+        # steps submitted but whose integrity manifest is not yet written —
+        # flushed once orbax commits (next save / wait / close)
+        self._pending_manifest: dict[int, dict] = {}
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -131,12 +147,76 @@ class Checkpointer:
             ),
         )
 
-    def save(self, state: TrainState) -> str:
+    def _step_path(self, step: int) -> str:
+        return str(
+            ocp.step.find_step_path(
+                self.directory, ocp.step.standard_name_format(), step=step
+            )
+        )
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for every committed pending step. Callers
+        guarantee orbax has finished (manifest = the post-commit seal;
+        writing earlier would certify bytes that aren't on disk yet)."""
+        if not self._pending_manifest:
+            return
+        committed = set(self._mngr.all_steps())
+        for step in sorted(self._pending_manifest):
+            tree = self._pending_manifest.pop(step)
+            if step not in committed:  # save failed/aborted: no seal
+                continue
+            if jax.process_index() == 0:
+                ckpt_manifest.write_manifest(
+                    self._step_path(step),
+                    ckpt_manifest.build_manifest(
+                        self._step_path(step), step, tree=tree
+                    ),
+                )
+
+    def save(self, state: TrainState, *, force: bool = False) -> str:
         step = int(jax.device_get(state.step))
-        t0 = time.perf_counter()
-        self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
-        submit_s = time.perf_counter() - t0
         reg = get_registry()
+        if not force and step in set(self._mngr.all_steps()):
+            # a resume immediately followed by a periodic/emergency save
+            # lands on an already-saved step — skip instead of hitting
+            # orbax's step-exists error mid-run. But only trust the existing
+            # copy if it verifies (or its manifest is still pending from
+            # THIS process): a run that fell back past a corrupt latest step
+            # must replace it when training reaches that step again, not
+            # leave the damage on disk for the next resume to dodge.
+            ok, reason = True, "ok"
+            if step not in self._pending_manifest and self.verify != "off":
+                ok, reason = ckpt_manifest.verify_step(
+                    self._step_path(step), level=self.verify
+                )
+            if ok:
+                reg.inc("checkpoint/duplicate_skips")
+                reg.emit({"record": "checkpoint_skip_duplicate", "step": step})
+                log0(f"checkpoint skip: step {step} already saved")
+                return os.path.join(self.directory, str(step))
+            reg.inc("checkpoint/resaves")
+            reg.emit({
+                "record": "checkpoint_resave",
+                "step": step,
+                "reason": reason,
+            })
+            log0(
+                f"checkpoint step {step} exists but fails verification "
+                f"({reason}); deleting and re-saving"
+            )
+            self._mngr.delete(step)
+        t0 = time.perf_counter()
+        if self._pending_manifest:
+            # join the in-flight save (orbax serializes saves anyway) so its
+            # manifest commits before a newer step supersedes it
+            with watchdog_guard("checkpoint_join"):
+                self._mngr.wait_until_finished()
+            self._flush_manifests()
+        self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
+        self._pending_manifest[step] = ckpt_manifest.tree_summary(
+            _saveable(state)
+        )
+        submit_s = time.perf_counter() - t0
         reg.inc("checkpoint/saves")
         # submit time = what the training loop actually pays (orbax
         # serializes asynchronously; the join is timed at wait/close)
@@ -153,32 +233,138 @@ class Checkpointer:
     def wait(self) -> None:
         """Join any in-flight async save (fault-injection and tests; a
         normal run only joins at ``close()``)."""
-        with get_registry().timer("checkpoint/join_s"):
+        with get_registry().timer("checkpoint/join_s"), watchdog_guard(
+            "checkpoint_join"
+        ):
             self._mngr.wait_until_finished()
+        self._flush_manifests()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
-    def restore(self, state: TrainState, *, step: Optional[int] = None) -> TrainState:
-        step = self._mngr.latest_step() if step is None else step
-        if step is None:
+    def verified_latest_step(self) -> Optional[int]:
+        """The newest step that passes integrity verification — what a
+        restore with no explicit step will actually use. None when no step
+        verifies (including manifest-less legacy steps)."""
+        for step in sorted(self._mngr.all_steps(), reverse=True):
+            ok, _ = ckpt_manifest.verify_step(
+                self._step_path(step), level=self.verify or "size"
+            )
+            if ok:
+                return step
+        return None
+
+    def _restore_candidates(self) -> list[int]:
+        """Steps to try restoring, best first: verified steps newest-first;
+        if NONE verifies and none has a manifest (a pre-manifest legacy
+        directory) every step newest-first; else the corrupt steps are
+        excluded and an empty tail means CheckpointCorruptError."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        t0 = time.perf_counter()
-        restored = _restore_standard(self._mngr, step, state)
-        restore_s = time.perf_counter() - t0
+        if self.verify == "off":
+            return steps
+        verified, reasons, any_manifest = [], {}, False
+        for step in steps:
+            path = self._step_path(step)
+            if ckpt_manifest.read_manifest(path) is not None:
+                any_manifest = True
+            ok, reason = ckpt_manifest.verify_step(path, level=self.verify)
+            if ok:
+                verified.append(step)
+            else:
+                reasons[step] = reason
+        if verified:
+            if steps[0] not in verified:
+                reg = get_registry()
+                reg.inc("checkpoint/fallbacks")
+                reg.emit({
+                    "record": "checkpoint_fallback",
+                    "latest_step": steps[0],
+                    "fallback_step": verified[0],
+                    "reason": reasons.get(steps[0], "unverified"),
+                })
+                log0(
+                    f"checkpoint step {steps[0]} failed verification "
+                    f"({reasons.get(steps[0])}); falling back to verified "
+                    f"step {verified[0]}"
+                )
+            return verified
+        if not any_manifest:
+            log0(
+                f"no checkpoint under {self.directory} carries an integrity "
+                f"manifest (legacy save?); restoring latest unverified"
+            )
+            return steps
+        raise CheckpointCorruptError(
+            f"no verified checkpoint under {self.directory}: "
+            + "; ".join(f"step {s}: {r}" for s, r in reasons.items())
+        )
+
+    def restore(self, state: TrainState, *, step: Optional[int] = None) -> TrainState:
+        candidates = [step] if step is not None else self._restore_candidates()
         reg = get_registry()
-        reg.observe("checkpoint/restore_s", restore_s)
-        reg.emit({
-            "record": "checkpoint_restore",
-            "step": step,
-            "restore_s": restore_s,
-            "path": os.path.join(self.directory, str(step)),
-        })
-        log0(f"checkpoint restored: {self.directory}/{step}")
-        return _merge_restored(state, dict(restored))
+        last_exc: Exception | None = None
+        for i, cand in enumerate(candidates):
+            t0 = time.perf_counter()
+            try:
+                restored = _restore_standard(self._mngr, cand, state)
+            except Exception as e:
+                # verification passed but orbax couldn't read it (damage a
+                # size check can't see): fall through to the next verified
+                # step rather than kill a resumable run
+                last_exc = e
+                if i + 1 < len(candidates):
+                    reg.inc("checkpoint/fallbacks")
+                    reg.emit({
+                        "record": "checkpoint_fallback",
+                        "latest_step": cand,
+                        "fallback_step": candidates[i + 1],
+                        "reason": f"restore failed: {type(e).__name__}",
+                    })
+                    log0(
+                        f"checkpoint restore of step {cand} failed "
+                        f"({type(e).__name__}: {e}); trying step "
+                        f"{candidates[i + 1]}"
+                    )
+                continue
+            restore_s = time.perf_counter() - t0
+            reg.observe("checkpoint/restore_s", restore_s)
+            reg.emit({
+                "record": "checkpoint_restore",
+                "step": cand,
+                "restore_s": restore_s,
+                "path": os.path.join(self.directory, str(cand)),
+            })
+            log0(f"checkpoint restored: {self.directory}/{cand}")
+            return _merge_restored(state, dict(restored))
+        assert last_exc is not None
+        raise last_exc
 
     def close(self) -> None:
-        self._mngr.wait_until_finished()
+        with watchdog_guard("checkpoint_join"):
+            self._mngr.wait_until_finished()
+        self._flush_manifests()
+        # fault injection (PDT_TPU_FAULT=corrupt_ckpt:...): damage a
+        # COMMITTED, manifest-sealed step so the next restore must detect
+        # it and fall back — exercised after the manifests above land
+        from pytorch_distributed_training_tpu.faults.inject import (
+            corrupt_step_dir,
+            get_plan,
+        )
+
+        target = get_plan().corrupt_checkpoint_target()
+        if target is not None and jax.process_index() == 0:
+            step = (
+                self._mngr.latest_step() if target == "latest" else int(target)
+            )
+            if step is not None:
+                corrupt_step_dir(self._step_path(step))
+                get_registry().emit({
+                    "record": "fault_injected",
+                    "fault": "corrupt_ckpt",
+                    "step": step,
+                })
         self._mngr.close()
 
 
@@ -193,6 +379,20 @@ def save_checkpoint(directory: str, state: TrainState, *, keep: int = 3) -> str:
     ) as mngr:
         mngr.save(step, args=ocp.args.StandardSave(_saveable(state)))
         mngr.wait_until_finished()
+        if jax.process_index() == 0:
+            step_path = str(
+                ocp.step.find_step_path(
+                    directory, ocp.step.standard_name_format(), step=step
+                )
+            )
+            ckpt_manifest.write_manifest(
+                step_path,
+                ckpt_manifest.build_manifest(
+                    step_path, step, tree=ckpt_manifest.tree_summary(
+                        _saveable(state)
+                    )
+                ),
+            )
     get_registry().observe("checkpoint/save_s", time.perf_counter() - t0)
     log0(f"checkpoint saved: {directory}/{step}")
     return os.path.join(directory, str(step))
@@ -203,6 +403,28 @@ def latest_step(directory: str) -> Optional[int]:
         return None
     with ocp.CheckpointManager(directory) as mngr:
         return mngr.latest_step()
+
+
+def verified_latest_step(
+    directory: str, *, level: str = "size"
+) -> Optional[int]:
+    """The newest step under ``directory`` passing integrity verification —
+    what a no-explicit-step restore will use; the supervisor logs it before
+    each retry and ``scripts/verify_checkpoint.py`` reports it offline."""
+    if not os.path.isdir(directory):
+        return None
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(directory) as mngr:
+        for step in sorted(mngr.all_steps(), reverse=True):
+            step_path = str(
+                ocp.step.find_step_path(
+                    directory, ocp.step.standard_name_format(), step=step
+                )
+            )
+            ok, _ = ckpt_manifest.verify_step(step_path, level=level)
+            if ok:
+                return step
+    return None
 
 
 def restore_params(directory: str, *, params_like=None, step: Optional[int] = None):

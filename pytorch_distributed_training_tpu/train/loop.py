@@ -16,6 +16,7 @@ north-star metric (BASELINE.md).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Optional
 
@@ -25,6 +26,15 @@ import numpy as np
 
 from pytorch_distributed_training_tpu.comms import initialize
 from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+from pytorch_distributed_training_tpu.faults.inject import get_plan
+from pytorch_distributed_training_tpu.faults.preemption import (
+    GracefulShutdown,
+    Preempted,
+)
+from pytorch_distributed_training_tpu.faults.watchdog import (
+    Watchdog,
+    set_watchdog,
+)
 from pytorch_distributed_training_tpu.data import ShardedLoader, load_task_arrays
 from pytorch_distributed_training_tpu.models import BertForSequenceClassification
 from pytorch_distributed_training_tpu.parallel import ShardingPolicy, state_shardings
@@ -207,7 +217,10 @@ class Trainer:
         self.state = shard_state(state, self.shardings)
 
         self.checkpointer = (
-            ckpt.Checkpointer(train_config.checkpoint_dir)
+            ckpt.Checkpointer(
+                train_config.checkpoint_dir,
+                verify=train_config.checkpoint_verify,
+            )
             if train_config.checkpoint_dir
             else None
         )
@@ -407,9 +420,31 @@ class Trainer:
             f"{cfg.grad_accum_steps} × {cfg.global_batch_size // cfg.grad_accum_steps}), "
             f"mesh {dict(self.mesh.shape)}, {n_chips} chip(s)"
         )
+        # Hung-step watchdog: armed around device-blocking sections here and
+        # (via the module install) around checkpoint joins + host collectives
+        self.watchdog = (
+            Watchdog(
+                stall_factor=cfg.watchdog_stall_factor,
+                min_stall_s=cfg.watchdog_min_stall_s,
+                hard_timeout_s=cfg.watchdog_hard_timeout_s,
+            )
+            if cfg.watchdog
+            else None
+        )
+        prev_watchdog = set_watchdog(self.watchdog)
+        # Preemption-safe shutdown: handlers only set a flag; the step loop
+        # notices at the next boundary and exits through _preempt_exit
+        self._shutdown = (
+            GracefulShutdown().install() if cfg.handle_preemption else None
+        )
         try:
             self._run_epochs(cfg, n_chips, start_epoch, skip_in_first_epoch)
         finally:
+            if self._shutdown is not None:
+                self._shutdown.uninstall()
+            set_watchdog(prev_watchdog)
+            if self.watchdog is not None:
+                self.watchdog.close()
             # release native-loader worker threads / checkpoint threadpools
             # even when a train step raises (NaN abort, OOM, interrupt)
             if self.checkpointer:
@@ -418,12 +453,56 @@ class Trainer:
                 close = getattr(loader, "close", None)
                 if close:
                     close()
+            # crash path: the stream stays OPEN (the supervisor's restart
+            # event and the next attempt append to it) but is pushed to disk
+            # — restart/preemption/stall records must survive the process
+            if self.metrics_sink is not None:
+                self.metrics_sink.flush(fsync=True)
         # Closed on the CLEAN path only: after a crash the stream stays open
         # (every record is already flushed) so the supervisor's restart event
         # and the next attempt's header append to the same file.
         if self.metrics_sink is not None:
             self.metrics_sink.close()
         return self.history
+
+    def _preempt_exit(self, signum: int, step_no: int) -> None:
+        """SIGTERM/SIGINT arrived: emergency-save inside the grace window,
+        record the preemption, and exit RESUMABLE (code 75) — the supervisor
+        must not burn a restart on a host that is being taken away."""
+        cfg = self.tcfg
+        t0 = time.perf_counter()
+        saved_step = None
+        if self.checkpointer is not None:
+            # duplicate-step saves (preempted right after a periodic save)
+            # are skipped by the Checkpointer, not errors
+            self.checkpointer.save(self.state)
+            self.checkpointer.wait()
+            saved_step = int(jax.device_get(self.state.step))
+        save_wall_s = time.perf_counter() - t0
+        if save_wall_s > cfg.preempt_grace_s:
+            log0(
+                f"emergency checkpoint took {save_wall_s:.1f}s, over the "
+                f"{cfg.preempt_grace_s:.0f}s grace window — the checkpoint "
+                f"landed but the infra may have SIGKILLed peers; consider "
+                f"more frequent periodic saves"
+            )
+        self.registry.inc("preemptions")
+        self.registry.emit({
+            "record": "preemption",
+            "signal": signum,
+            "step": step_no,
+            "saved_step": saved_step,
+            "save_wall_s": save_wall_s,
+            "grace_s": cfg.preempt_grace_s,
+        })
+        if self.metrics_sink is not None:
+            self.metrics_sink.flush(fsync=True)
+        log0(
+            f"preempted at step {step_no}: emergency checkpoint "
+            f"{'at step ' + str(saved_step) if saved_step is not None else 'skipped (no checkpoint_dir)'}, "
+            f"exiting resumable"
+        )
+        raise Preempted(signum, step=step_no)
 
     def _run_epochs(self, cfg, n_chips, start_epoch, skip_in_first_epoch):
         # Per-step telemetry (metrics_dir set) synchronizes on each step's
@@ -457,6 +536,11 @@ class Trainer:
                 buf = []
                 t_prev = time.perf_counter()
                 for i, batch in enumerate(self.train_loader.epoch(epoch)):
+                    if (
+                        self._shutdown is not None
+                        and self._shutdown.requested is not None
+                    ):
+                        self._preempt_exit(self._shutdown.requested, step_no)
                     t_batch = time.perf_counter()
                     data_wait = t_batch - t_prev
                     if i < skip:
@@ -477,14 +561,23 @@ class Trainer:
                         )
                         buf.clear()
                     compile_inclusive = not self._first_step_done
-                    with annotate("train_step"):
+                    # watchdog arms over dispatch + (per_step) device block:
+                    # a hung collective inside the step surfaces here. The
+                    # compile-inclusive first step is exempt — tracing+XLA
+                    # time is unbounded-ish and is not a hang
+                    guard = (
+                        self.watchdog.guard("train_step", step=step_no + chain)
+                        if self.watchdog is not None and not compile_inclusive
+                        else contextlib.nullcontext()
+                    )
+                    with annotate("train_step"), guard:
                         self.state, metrics = self.train_step(self.state, batch)
-                    self._first_step_done = True
-                    t_dispatched = time.perf_counter()
-                    if per_step:
-                        # join this step so device_block_s is real device
-                        # time, not queue depth
-                        jax.block_until_ready(metrics["loss"])
+                        self._first_step_done = True
+                        t_dispatched = time.perf_counter()
+                        if per_step:
+                            # join this step so device_block_s is real device
+                            # time, not queue depth
+                            jax.block_until_ready(metrics["loss"])
                     t_done = time.perf_counter()
                     samples += cfg.global_batch_size * chain
                     losses.append(metrics["loss"])
@@ -545,8 +638,20 @@ class Trainer:
                             flush=True,
                         )
                         _os._exit(13)
+                    # PDT_TPU_FAULT step faults (faults/inject.py): raise an
+                    # InjectedCrash (supervisor-retryable), self-SIGTERM
+                    # (preemption path) or hang (watchdog path) right after
+                    # completing this update
+                    get_plan().fire_step_fault(step_no)
                     t_prev = time.perf_counter()
-                jax.block_until_ready(self.state.params)
+                with (
+                    self.watchdog.guard("epoch_block", step=step_no)
+                    if self.watchdog is not None
+                    else contextlib.nullcontext()
+                ):
+                    # with per-step sync off this join is where a wedged
+                    # device/collective actually surfaces
+                    jax.block_until_ready(self.state.params)
                 train_time = time.perf_counter() - epoch_t0
                 # every host contributes its step-time stats; process 0's
                 # epoch record then names the slowest host (telemetry/

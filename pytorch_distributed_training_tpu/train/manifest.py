@@ -1,0 +1,168 @@
+"""Per-checkpoint integrity manifests: commit markers that can prove it.
+
+Orbax commits a step atomically on a POSIX filesystem, but "the directory
+exists" is not "the bytes are right": a torn GCS upload, a disk error, an
+operator's stray ``rm``, or a truncated copy between machines all leave a
+step that ``latest_step()`` happily returns and restore then dies (or
+worse, silently half-loads) on. The manifest is written AFTER orbax
+finishes committing a step, next to it, and records:
+
+- the saved pytree's structure (per-leaf path, shape, dtype) — catches a
+  checkpoint written by an incompatible config before orbax's opaque
+  tree-mismatch error does;
+- a file inventory of the committed step directory (per-file byte size +
+  sha256) — catches truncation and partial writes by size, bit rot and
+  overwrites by digest;
+- framework versions and a wall-clock stamp — the provenance a post-mortem
+  needs.
+
+``verify_step`` is the single checker behind ``Checkpointer.restore``'s
+fall-back-to-newest-verified-step walk and the offline
+``scripts/verify_checkpoint.py`` validator. Verification levels: ``"size"``
+(cheap: existence + byte sizes; catches truncation/partial commits) and
+``"digest"`` (full sha256 re-hash; catches same-size corruption — what
+``--strict`` uses). A step with no manifest at all verifies only in
+``legacy_ok`` mode (checkpoints written before manifests existed).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+MANIFEST_NAME = "pdt_manifest.json"
+MANIFEST_FORMAT = 1
+
+VERIFY_LEVELS = ("off", "size", "digest")
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _walk_files(step_path: str):
+    for root, _dirs, files in os.walk(step_path):
+        for name in sorted(files):
+            if name == MANIFEST_NAME:
+                continue
+            full = os.path.join(root, name)
+            yield os.path.relpath(full, step_path), full
+
+
+def tree_summary(tree) -> dict[str, dict]:
+    """{leaf path: {shape, dtype}} for the saved pytree — shape/dtype only,
+    so the summary is identical across hosts and shardings."""
+    import jax
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[jax.tree_util.keystr(path)] = {
+            "shape": list(getattr(leaf, "shape", ())),
+            "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+        }
+    return out
+
+
+def build_manifest(step_path: str, step: int, tree: dict | None = None) -> dict:
+    """Inventory a COMMITTED step directory (call only after orbax's
+    ``wait_until_finished``). ``tree`` is a prebuilt ``tree_summary`` —
+    captured at save time, when the caller still holds the pytree."""
+    import jax
+
+    files = {}
+    for rel, full in _walk_files(step_path):
+        files[rel] = {
+            "bytes": os.path.getsize(full),
+            "sha256": _sha256(full),
+        }
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "files": files,
+        "versions": {
+            "jax": jax.__version__,
+            "orbax": __import__("orbax.checkpoint", fromlist=["_"]).__version__,
+        },
+        "written_at": time.time(),
+    }
+    if tree is not None:
+        manifest["tree"] = tree
+    return manifest
+
+
+def write_manifest(step_path: str, manifest: dict) -> str:
+    """Atomic write (tmp + rename): a crash mid-write leaves no manifest —
+    which verification treats as unverified, never as half-trusted."""
+    path = os.path.join(step_path, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(step_path: str) -> dict | None:
+    path = os.path.join(step_path, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    except (json.JSONDecodeError, OSError):
+        return {}  # present but unreadable: corrupt, not legacy
+    if not isinstance(manifest, dict) or "files" not in manifest:
+        return {}
+    return manifest
+
+
+def verify_step(
+    step_path: str, *, level: str = "size", legacy_ok: bool = False
+) -> tuple[bool, str]:
+    """Check a committed step against its manifest.
+
+    Returns ``(ok, reason)``; ``reason`` is ``"ok"`` on success, else the
+    first failure found (one is enough to disqualify the step).
+    """
+    if level not in VERIFY_LEVELS:
+        raise ValueError(
+            f"verify level must be one of {VERIFY_LEVELS}, got {level!r}"
+        )
+    if level == "off":
+        return True, "ok"
+    if not os.path.isdir(step_path):
+        return False, "step directory missing"
+    manifest = read_manifest(step_path)
+    if manifest is None:
+        if legacy_ok:
+            return True, "no manifest (legacy checkpoint, accepted)"
+        return False, "no manifest"
+    if not manifest:
+        return False, "manifest unreadable"
+    files = manifest["files"]
+    if not files:
+        return False, "manifest lists no files"
+    for rel, want in files.items():
+        full = os.path.join(step_path, rel)
+        try:
+            size = os.path.getsize(full)
+        except OSError:
+            return False, f"file missing: {rel}"
+        if size != want["bytes"]:
+            return False, (
+                f"size mismatch: {rel} has {size} bytes, "
+                f"manifest says {want['bytes']}"
+            )
+        if level == "digest" and _sha256(full) != want["sha256"]:
+            return False, f"digest mismatch: {rel}"
+    return True, "ok"

@@ -333,6 +333,32 @@ class TrainConfig:
     # completing update number ``crash_at_step``. 0 = disabled.
     crash_at_step: int = 0
     crash_rank: int = 0
+    # ---------------------------------------------------- fault tolerance
+    # Preemption-safe shutdown (faults/preemption.py): SIGTERM/SIGINT set a
+    # flag; the Trainer stops at the next step boundary, writes an emergency
+    # checkpoint (if checkpoint_dir is set) inside preempt_grace_s, emits a
+    # `preemption` telemetry record and exits RESUMABLE (code 75) so an
+    # external supervisor restarts without burning a failure-budget slot.
+    handle_preemption: bool = True
+    preempt_grace_s: float = 30.0
+    # Hung-step watchdog (faults/watchdog.py): armed around device-blocking
+    # sections (step dispatch/block, checkpoint joins, host collectives).
+    # After max(watchdog_min_stall_s, watchdog_stall_factor x rolling-median
+    # section time) it records a `watchdog_stall` with all-thread stacks;
+    # past watchdog_hard_timeout_s it aborts the process (exit 84) so the
+    # supervisor restarts instead of hanging forever. hard_timeout 0 = never
+    # abort (stall records only).
+    watchdog: bool = True
+    watchdog_stall_factor: float = 10.0
+    watchdog_min_stall_s: float = 60.0
+    watchdog_hard_timeout_s: float = 1800.0
+    # Checkpoint integrity verification level on restore (train/manifest.py):
+    # "size" checks the per-save manifest's file inventory by byte size
+    # (catches truncation/partial commits); "digest" re-hashes every file
+    # (catches same-size corruption, costs a full read); "off" trusts orbax.
+    # A latest step that fails verification is skipped in favor of the
+    # newest VERIFIED step (Checkpointer.verified_latest_step).
+    checkpoint_verify: str = "size"
     profile_dir: str | None = None  # enable jax.profiler traces when set
     debug_nans: bool = False
     # Structured telemetry (telemetry/): when set, process 0 appends a JSONL

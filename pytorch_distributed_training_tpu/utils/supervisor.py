@@ -7,20 +7,31 @@ checks (SURVEY.md §5). The TPU framework's recovery story is
 restart-from-checkpoint: ``jax.distributed`` already propagates coordinator
 failure to every process (the detection half), and this module supplies the
 recovery half — re-run the training function, which resumes from the latest
-checkpoint (``TrainConfig.resume=True`` + ``checkpoint_dir``) and continues
-the exact optimizer/data trajectory (mid-epoch resume, train/loop.py).
+VERIFIED checkpoint (``TrainConfig.resume=True`` + ``checkpoint_dir``;
+integrity verification + fallback in train/checkpoint.py) and continues the
+exact optimizer/data trajectory (mid-epoch resume, train/loop.py).
 
-Transient infra failures (preemption, a flaky host, one bad allreduce) get
-``max_restarts`` fresh attempts with exponential backoff; deterministic
-failures (a real bug) burn the attempts quickly and the final exception
-propagates unchanged.
+Transient infra failures (a flaky host, one bad allreduce) get restart
+attempts with decorrelated-jitter exponential backoff — jitter so a
+multi-host fleet restarting in lockstep doesn't stampede the coordinator —
+while deterministic failures (a real bug) burn the budget quickly and the
+final exception propagates unchanged. The budget is either lifetime
+(``max_restarts`` total, the default) or sliding-window (``max_restarts``
+within ``restart_window_s``), so a weeks-long run survives occasional
+preemptions without granting a slow-burning deterministic bug unlimited
+retries in a tight loop. A preemption (``faults.preemption.Preempted``,
+exit code 75) is NOT a failure: it propagates immediately without burning a
+restart — the host is going away; the external supervisor requeues.
 """
 
 from __future__ import annotations
 
+import random
 import time
+from collections import deque
 from typing import Callable, TypeVar
 
+from pytorch_distributed_training_tpu.faults.preemption import Preempted
 from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 from pytorch_distributed_training_tpu.utils.logging import log0
 
@@ -34,47 +45,108 @@ def run_with_restarts(
     backoff_s: float = 5.0,
     backoff_factor: float = 2.0,
     max_backoff_s: float = 300.0,
+    restart_window_s: float = 0.0,
+    jitter: bool = True,
+    checkpoint_dir: str | None = None,
     on_failure: Callable[[int, BaseException], None] | None = None,
+    _rng: random.Random | None = None,
 ) -> T:
     """Call ``make_attempt(attempt_index)`` until it returns, restarting on
-    exception up to ``max_restarts`` times.
+    exception while the restart budget allows.
 
     ``make_attempt`` must build a FRESH run each call (new Trainer with
     ``resume=True``): a failed attempt's runtime state — devices, loaders,
     jit caches — is assumed poisoned; only the checkpoint survives. Raises
-    the last failure when attempts are exhausted. KeyboardInterrupt is never
-    retried.
+    the last failure when the budget is exhausted. KeyboardInterrupt is
+    never retried; ``Preempted`` (graceful SIGTERM shutdown) propagates
+    immediately WITHOUT burning a restart — the process exit code (75)
+    tells the external supervisor "resumable".
+
+    - ``restart_window_s > 0``: the budget is ``max_restarts`` restarts
+      within any window of that many seconds (older restarts expire), so a
+      long run tolerates occasional failures forever but a crash loop still
+      exhausts quickly. ``0`` keeps the lifetime budget.
+    - ``jitter=True`` draws each delay uniformly from
+      ``[backoff_s, prev_delay * backoff_factor]`` (decorrelated jitter):
+      hosts that died together don't re-register with the coordinator in
+      lockstep. ``jitter=False`` keeps the deterministic schedule.
+    - ``checkpoint_dir``: when given, each retry logs and records the
+      verified step the resume will start from (walked via
+      ``checkpoint.verified_latest_step`` — a corrupt latest step is
+      reported here, before the attempt even builds).
     """
+    rng = _rng or random.Random()
     attempt = 0
     delay = backoff_s
+    restart_times: deque[float] = deque()
     while True:
         try:
             return make_attempt(attempt)
         except KeyboardInterrupt:
             raise
+        except Preempted:
+            log0(
+                "preempted: exiting resumable (code 75) without burning a "
+                "restart"
+            )
+            raise
         except Exception as e:
             if on_failure is not None:
                 on_failure(attempt, e)
+            now = time.monotonic()
+            if restart_window_s > 0:
+                while restart_times and now - restart_times[0] > restart_window_s:
+                    restart_times.popleft()
+                will_retry = len(restart_times) < max_restarts
+            else:
+                will_retry = attempt < max_restarts
+            resume_step = None
+            if will_retry and checkpoint_dir is not None:
+                from pytorch_distributed_training_tpu.train.checkpoint import (
+                    verified_latest_step,
+                )
+
+                resume_step = verified_latest_step(checkpoint_dir)
             # the failed attempt's registry/sink are still installed (the
             # Trainer leaves the stream open on a crash), so the restart
             # event lands in the same metrics JSONL the attempt was writing
             reg = get_registry()
-            if attempt < max_restarts:
+            if will_retry:
                 reg.inc("supervisor/restarts")
             reg.emit({
                 "record": "restart",
                 "attempt": attempt,
                 "error": type(e).__name__,
                 "message": str(e)[:500],
-                "will_retry": attempt < max_restarts,
+                "will_retry": will_retry,
+                **(
+                    {"resume_step": resume_step}
+                    if checkpoint_dir is not None
+                    else {}
+                ),
             })
-            if attempt >= max_restarts:
+            if not will_retry:
                 raise
+            restart_times.append(now)
+            sleep_s = (
+                rng.uniform(backoff_s, max(backoff_s, delay * backoff_factor))
+                if jitter
+                else delay
+            )
+            sleep_s = min(sleep_s, max_backoff_s)
             log0(
                 f"attempt {attempt} failed ({type(e).__name__}: {e}); "
-                f"restarting from latest checkpoint in {delay:.0f}s "
-                f"({max_restarts - attempt} restart(s) left)"
+                f"restarting from "
+                + (
+                    f"verified checkpoint step {resume_step} "
+                    if resume_step is not None
+                    else "latest checkpoint "
+                )
+                + f"in {sleep_s:.1f}s"
             )
-            time.sleep(delay)
-            delay = min(delay * backoff_factor, max_backoff_s)
+            time.sleep(sleep_s)
+            delay = min(
+                sleep_s if jitter else delay * backoff_factor, max_backoff_s
+            )
+            delay = max(delay, backoff_s)
             attempt += 1

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Offline checkpoint validator — the documented pre-resume check.
+
+Walks every step under a checkpoint directory and verifies each against its
+integrity manifest (pytorch_distributed_training_tpu/train/manifest.py):
+file inventory by byte size, and with ``--strict`` a full sha256 re-hash
+that catches same-size corruption. Run it before resuming a long job on a
+directory you didn't just write (a copied/restored/aged one):
+
+    python scripts/verify_checkpoint.py /ckpts/run17 --strict
+
+Exit codes:
+  0 — every step verified (what a resume will restore is trustworthy);
+  2 — some steps failed but a verified step exists (resume will FALL BACK
+      to the newest verified step — decide if that is acceptable);
+  1 — no step verified (resume would need --checkpoint-verify off, at your
+      own risk) or the directory holds no checkpoint.
+
+Runs with JAX_PLATFORMS=cpu-safe imports only — no devices touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("directory", help="checkpoint directory (a run's --checkpoint-dir)")
+    p.add_argument("--step", type=int, default=None,
+                   help="verify only this step (default: every step)")
+    p.add_argument("--strict", action="store_true",
+                   help="re-hash every file (sha256) instead of size-only — "
+                        "catches same-size corruption; costs a full read")
+    p.add_argument("--quiet", action="store_true",
+                   help="exit code only, no per-step report")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import orbax.checkpoint as ocp
+
+    from pytorch_distributed_training_tpu.train import manifest
+
+    directory = os.path.abspath(args.directory)
+    if not os.path.isdir(directory):
+        print(f"{directory}: not a directory", file=sys.stderr)
+        return 1
+    level = "digest" if args.strict else "size"
+    with ocp.CheckpointManager(directory) as mngr:
+        steps = sorted(mngr.all_steps())
+        if args.step is not None:
+            if args.step not in steps:
+                print(f"step {args.step} not found (have {steps})",
+                      file=sys.stderr)
+                return 1
+            steps = [args.step]
+        results = {}
+        for step in steps:
+            path = str(
+                ocp.step.find_step_path(
+                    directory, ocp.step.standard_name_format(), step=step
+                )
+            )
+            results[step] = manifest.verify_step(path, level=level)
+    if not results:
+        print(f"no checkpoint under {directory}", file=sys.stderr)
+        return 1
+    verified = [s for s, (ok, _) in results.items() if ok]
+    if not args.quiet:
+        for step, (ok, reason) in sorted(results.items()):
+            print(f"step {step:>8}: {'OK' if ok else 'FAIL'} ({reason})")
+        newest = max(verified) if verified else None
+        print(
+            f"{len(verified)}/{len(results)} step(s) verified at level "
+            f"{level!r}; restore would use: "
+            f"{newest if newest is not None else 'NOTHING — no verified step'}"
+        )
+    if len(verified) == len(results):
+        return 0
+    return 2 if verified else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

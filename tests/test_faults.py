@@ -1,0 +1,832 @@
+"""Fault-tolerance tests: the recovery paths, exercised for real.
+
+The faults/ subsystem exists so "restart-from-checkpoint" is a tested
+guarantee instead of a docstring claim (ISSUE 2). Unit tiers pin the spec
+parser, checkpoint manifests, the watchdog state machine, the shutdown
+handler, and the supervisor's jitter/window budget; the integration tier
+drives the acceptance criteria end-to-end on the CPU mesh:
+
+- ``crash_at_step`` + supervisor restart resumes and matches an
+  uninterrupted run's params/opt_state bitwise;
+- ``corrupt_ckpt:latest`` makes the next restore fall back to the newest
+  VERIFIED step (and re-save over the damage when training passes it);
+- ``sigterm_at_step`` produces an emergency checkpoint and the resumable
+  exit code (75), and the run continues under ``--resume``.
+
+All CPU-only, all tier-1 (``-m faults`` selects just this file's tier).
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.faults.inject import (
+    FaultPlan,
+    InjectedCrash,
+    corrupt_step_dir,
+    get_plan,
+    set_plan,
+)
+from pytorch_distributed_training_tpu.faults.preemption import (
+    RESUMABLE_EXIT_CODE,
+    GracefulShutdown,
+    Preempted,
+)
+from pytorch_distributed_training_tpu.faults.watchdog import (
+    WATCHDOG_EXIT_CODE,
+    Watchdog,
+    set_watchdog,
+    watchdog_guard,
+)
+from pytorch_distributed_training_tpu.telemetry import (
+    JsonlSink,
+    MetricsRegistry,
+    set_registry,
+)
+from pytorch_distributed_training_tpu.train import manifest
+
+pytestmark = pytest.mark.faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """No fault plan or watchdog leaks between tests (a leaked
+    crash_at_step would fire inside an unrelated trainer run)."""
+    yield
+    set_plan(None)
+    set_watchdog(None)
+
+
+def _small_trainer(**tcfg_kw):
+    """Tiny synthetic-task Trainer on the 4x2 CPU mesh (the
+    test_trainer_integration recipe): 128 rows / batch 32 = 4 updates."""
+    from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+    from pytorch_distributed_training_tpu.train.loop import Trainer
+    from pytorch_distributed_training_tpu.utils.config import (
+        MeshConfig,
+        TrainConfig,
+        model_preset,
+    )
+
+    mcfg = model_preset("tiny", compute_dtype="float32")
+    defaults = dict(
+        num_epochs=1,
+        global_batch_size=32,
+        micro_batch_size=16,
+        eval_batch_size=32,
+        learning_rate=3e-3,
+        warmup_steps=10,
+        log_every=0,
+        bf16=False,
+        train_size=128,
+        eval_size=32,
+    )
+    defaults.update(tcfg_kw)
+    return Trainer(
+        mcfg, TrainConfig(**defaults), MeshConfig(data=4, fsdp=2),
+        ShardingPolicy(fsdp=True, fsdp_min_size=128),
+        task="synthetic",
+    )
+
+
+def _flat(tree) -> np.ndarray:
+    return np.concatenate(
+        [np.ravel(jax.device_get(x)) for x in jax.tree.leaves(tree)]
+    )
+
+
+def _step_path(directory: str, step: int) -> str:
+    import orbax.checkpoint as ocp
+
+    return str(
+        ocp.step.find_step_path(
+            directory, ocp.step.standard_name_format(), step=step
+        )
+    )
+
+
+def _records(path) -> list[dict]:
+    return [json.loads(l) for l in open(path).read().splitlines()]
+
+
+# ------------------------------------------------------------- spec parsing
+
+
+def test_fault_spec_parsing_all_kinds():
+    plan = FaultPlan.parse(
+        "crash_at_step:7, sigterm_at_step:5, hang_at_step:3,"
+        "corrupt_ckpt:latest, slow_host:2.5x, crash_at_step:9@1"
+    )
+    kinds = [(s.kind, s.rank) for s in plan.specs]
+    assert kinds == [
+        ("crash_at_step", 0), ("sigterm_at_step", 0), ("hang_at_step", 0),
+        ("corrupt_ckpt", 0), ("slow_host", 0), ("crash_at_step", 1),
+    ]
+    assert plan.specs[0].step == 7
+    assert plan.specs[3].target == "latest"
+    assert plan.specs[4].factor == 2.5
+    assert plan.specs[5].step == 9
+
+    assert FaultPlan.parse(None).specs == []
+    assert FaultPlan.parse("  ").specs == []
+    assert FaultPlan.parse("corrupt_ckpt:12").specs[0].target == "12"
+
+
+@pytest.mark.parametrize("bad", [
+    "crash_at_step",          # no arg
+    "explode_at_step:3",      # unknown kind
+    "crash_at_step:0",        # step must be positive
+    "corrupt_ckpt:newest",    # bad target
+    "slow_host:0.5x",         # factor < 1
+])
+def test_fault_spec_parsing_rejects(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_crash_fault_fires_exactly_once():
+    plan = FaultPlan.parse("crash_at_step:3")
+    plan.fire_step_fault(2)  # not our step: nothing
+    with pytest.raises(InjectedCrash):
+        plan.fire_step_fault(3)
+    # the restarted attempt re-walks step 3 in the SAME process: the spec
+    # is spent, so the retry converges instead of crash-looping
+    plan.fire_step_fault(3)
+
+
+def test_slow_host_stays_armed_and_stretches():
+    plan = FaultPlan.parse("slow_host:3x")
+    t0 = time.perf_counter()
+    plan.slow_host_delay(0.02)  # should sleep ~0.04 (2 extra x 0.02)
+    plan.slow_host_delay(0.02)  # a straggler is slow EVERY batch
+    assert time.perf_counter() - t0 >= 0.07
+    # wrong rank: never fires
+    other = FaultPlan.parse("slow_host:100x@1")
+    t0 = time.perf_counter()
+    other.slow_host_delay(1.0)
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_get_plan_parses_env_once(monkeypatch):
+    monkeypatch.setenv("PDT_TPU_FAULT", "crash_at_step:11")
+    set_plan(None)  # re-arm lazy parsing
+    plan = get_plan()
+    assert plan.specs[0].step == 11
+    monkeypatch.setenv("PDT_TPU_FAULT", "crash_at_step:99")
+    assert get_plan() is plan  # cached: fired-state survives restarts
+
+
+# ---------------------------------------------------------------- manifests
+
+
+def _fake_step_dir(tmp_path, name="step_7"):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "data.bin").write_bytes(b"A" * 1024)
+    (d / "meta.json").write_text("{}")
+    return str(d)
+
+
+def test_manifest_roundtrip_and_size_verify(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    m = manifest.build_manifest(d, 7, tree={"params['w']": {
+        "shape": [4], "dtype": "float32"}})
+    manifest.write_manifest(d, m)
+    got = manifest.read_manifest(d)
+    assert got["step"] == 7
+    assert set(got["files"]) == {"data.bin", "meta.json"}
+    assert got["files"]["data.bin"]["bytes"] == 1024
+    assert got["tree"]["params['w']"]["shape"] == [4]
+    assert got["versions"]["jax"]
+    assert manifest.verify_step(d, level="size") == (True, "ok")
+    assert manifest.verify_step(d, level="digest") == (True, "ok")
+
+
+def test_manifest_size_catches_truncation(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    manifest.write_manifest(d, manifest.build_manifest(d, 7))
+    with open(os.path.join(d, "data.bin"), "r+b") as f:
+        f.truncate(512)
+    ok, reason = manifest.verify_step(d, level="size")
+    assert not ok and "size mismatch" in reason
+
+
+def test_manifest_digest_catches_same_size_corruption(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    manifest.write_manifest(d, manifest.build_manifest(d, 7))
+    corrupt_step_dir(d)  # flips bytes, same length
+    assert manifest.verify_step(d, level="size") == (True, "ok")  # blind
+    ok, reason = manifest.verify_step(d, level="digest")
+    assert not ok and "digest mismatch" in reason
+
+
+def test_manifest_missing_file_and_missing_manifest(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    manifest.write_manifest(d, manifest.build_manifest(d, 7))
+    os.remove(os.path.join(d, "meta.json"))
+    ok, reason = manifest.verify_step(d, level="size")
+    assert not ok and "missing" in reason
+
+    bare = _fake_step_dir(tmp_path, "step_8")
+    assert manifest.verify_step(bare, level="size")[1] == "no manifest"
+    ok, reason = manifest.verify_step(bare, level="size", legacy_ok=True)
+    assert ok and "legacy" in reason
+
+
+def test_manifest_unreadable_is_corrupt_not_legacy(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    with open(os.path.join(d, manifest.MANIFEST_NAME), "w") as f:
+        f.write("{torn")
+    ok, reason = manifest.verify_step(d, level="size")
+    assert not ok and reason == "manifest unreadable"
+    assert manifest.read_manifest(d) == {}  # present-but-broken, not None
+
+
+def test_corrupt_step_dir_targets_largest_file_same_size(tmp_path):
+    d = _fake_step_dir(tmp_path)
+    before = open(os.path.join(d, "data.bin"), "rb").read()
+    victim = corrupt_step_dir(d)
+    assert victim.endswith("data.bin")  # the largest file
+    after = open(victim, "rb").read()
+    assert len(after) == len(before) and after != before
+
+
+# ----------------------------------------------------------------- watchdog
+
+
+def _reg_with_sink(tmp_path):
+    reg = MetricsRegistry()
+    sink = JsonlSink(str(tmp_path), process_index=0)
+    reg.attach_sink(sink)
+    return reg, sink
+
+
+def test_watchdog_stall_and_recover_records(tmp_path):
+    reg, sink = _reg_with_sink(tmp_path)
+    prev = set_registry(reg)
+    wd = Watchdog(stall_factor=10.0, min_stall_s=0.05, hard_timeout_s=0)
+    try:
+        with wd.guard("slow_section", step=3):
+            time.sleep(0.25)
+    finally:
+        wd.close()
+        set_registry(prev)
+        sink.close()
+    recs = _records(tmp_path / "metrics.jsonl")
+    stall = [r for r in recs if r["record"] == "watchdog_stall"]
+    rec = [r for r in recs if r["record"] == "watchdog_recovered"]
+    assert len(stall) == 1 and len(rec) == 1
+    assert stall[0]["section"] == "slow_section" and stall[0]["step"] == 3
+    # the stack dump names this test — the "which collective, from where"
+    # post-mortem the record exists for
+    assert "test_watchdog_stall" in stall[0]["stacks"]
+    assert rec[0]["duration_s"] >= 0.25
+
+
+def test_watchdog_hard_timeout_aborts_with_exit_code(tmp_path):
+    exits = []
+    reg, sink = _reg_with_sink(tmp_path)
+    prev = set_registry(reg)
+    wd = Watchdog(
+        stall_factor=10.0, min_stall_s=0.02, hard_timeout_s=0.1,
+        _exit=exits.append,
+    )
+    try:
+        with wd.guard("hung_collective"):
+            t0 = time.monotonic()
+            while not exits and time.monotonic() - t0 < 10:
+                time.sleep(0.01)  # a wedged section never returns on its own
+    finally:
+        wd.close()
+        set_registry(prev)
+        sink.close()
+    assert exits == [WATCHDOG_EXIT_CODE]
+    recs = _records(tmp_path / "metrics.jsonl")
+    kinds = [r["record"] for r in recs]
+    assert "watchdog_stall" in kinds and "watchdog_abort" in kinds
+    abort = next(r for r in recs if r["record"] == "watchdog_abort")
+    assert abort["section"] == "hung_collective"
+    assert abort["exit_code"] == WATCHDOG_EXIT_CODE
+    assert abort["stacks"]
+
+
+def test_watchdog_threshold_tracks_rolling_median():
+    wd = Watchdog(stall_factor=4.0, min_stall_s=0.5, hard_timeout_s=0)
+    assert wd.stall_after_s("step") == 0.5  # no history: the floor
+    for s in (1.0, 1.0, 1.0, 30.0):  # median robust to one outlier
+        wd.observe("step", s)
+    assert wd.stall_after_s("step") == pytest.approx(4.0)
+    wd.close()
+
+
+def test_watchdog_rejects_bad_thresholds():
+    with pytest.raises(ValueError, match="watchdog"):
+        Watchdog(stall_factor=0)
+
+
+def test_watchdog_guard_without_install_is_noop():
+    assert set_watchdog(None) is None  # nothing installed
+    with watchdog_guard("anything"):
+        pass  # must not arm, spawn threads, or raise
+
+
+# --------------------------------------------------------------- preemption
+
+
+def test_graceful_shutdown_flag_install_uninstall():
+    gs = GracefulShutdown(handle_sigint=False)
+    before = signal.getsignal(signal.SIGINT)
+    with gs:
+        assert gs.installed
+        assert signal.getsignal(signal.SIGINT) is before  # SIGINT untouched
+        assert signal.getsignal(signal.SIGTERM) == gs._handle
+        assert gs.requested is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)  # handler runs at the next bytecode boundary
+        assert gs.requested == signal.SIGTERM  # flag only — no raise
+    assert not gs.installed
+    # uninstalled: a later SIGTERM hits whatever was there before, not gs
+    assert signal.getsignal(signal.SIGTERM) != gs._handle
+
+
+def test_preempted_carries_resumable_exit_code():
+    exc = Preempted(signal.SIGTERM, step=12)
+    assert isinstance(exc, SystemExit)  # untouched, it EXITS with the code
+    assert exc.code == RESUMABLE_EXIT_CODE == 75
+    assert exc.step == 12
+    assert "SIGTERM" in str(exc) and "75" in str(exc)
+
+
+# --------------------------------------------------------------- supervisor
+
+
+class _FakeTime:
+    """Deterministic clock for the supervisor: sleep() advances monotonic()."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+@pytest.fixture
+def fake_time(monkeypatch):
+    from pytorch_distributed_training_tpu.utils import supervisor
+
+    ft = _FakeTime()
+    monkeypatch.setattr(supervisor, "time", ft)
+    return ft
+
+
+def test_supervisor_jitter_stays_in_bounds(fake_time):
+    import random
+
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        if i < 4:
+            raise RuntimeError("flaky host")
+        return "ok"
+
+    out = run_with_restarts(
+        attempt, max_restarts=4, backoff_s=2.0, backoff_factor=3.0,
+        max_backoff_s=10.0, _rng=random.Random(0),
+    )
+    assert out == "ok" and calls == [0, 1, 2, 3, 4]
+    assert len(fake_time.sleeps) == 4
+    # decorrelated jitter: every delay in [backoff_s, max_backoff_s], and
+    # the schedule is not the deterministic 2/6/18/... lockstep ramp
+    for s in fake_time.sleeps:
+        assert 2.0 <= s <= 10.0
+    assert fake_time.sleeps != [2.0, 6.0, 10.0, 10.0]
+
+
+def test_supervisor_lifetime_budget_exhausts(fake_time):
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise RuntimeError("deterministic bug")
+
+    with pytest.raises(RuntimeError, match="deterministic bug"):
+        run_with_restarts(attempt, max_restarts=2, backoff_s=1.0, jitter=False)
+    assert calls == [0, 1, 2]  # the budget bounds a crash loop
+
+
+def test_supervisor_sliding_window_lets_old_restarts_expire(fake_time):
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        if i < 4:
+            raise RuntimeError("occasional failure")
+        return "done"
+
+    # 4 failures spaced 5s (the backoff) apart with a 2-restart budget per
+    # 8s window: each failure sees at most one unexpired restart, so a long
+    # run survives them all — where the lifetime budget above died at 3
+    out = run_with_restarts(
+        attempt, max_restarts=2, backoff_s=5.0, backoff_factor=1.0,
+        jitter=False, restart_window_s=8.0,
+    )
+    assert out == "done" and calls == [0, 1, 2, 3, 4]
+
+
+def test_supervisor_window_still_stops_a_crash_loop(fake_time):
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise RuntimeError("tight crash loop")
+
+    with pytest.raises(RuntimeError):
+        run_with_restarts(
+            attempt, max_restarts=2, backoff_s=1.0, backoff_factor=1.0,
+            jitter=False, restart_window_s=100.0,
+        )
+    assert calls == [0, 1, 2]  # both in-window slots burned, then raise
+
+
+def test_supervisor_preempted_propagates_without_burning_a_restart(fake_time):
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise Preempted(signal.SIGTERM, step=3)
+
+    with pytest.raises(Preempted) as exc:
+        run_with_restarts(attempt, max_restarts=5, backoff_s=1.0)
+    assert exc.value.code == RESUMABLE_EXIT_CODE
+    assert calls == [0]  # no retry: the host is going away
+    assert fake_time.sleeps == []
+
+
+# ------------------------------------------------- checkpoint integrity (IT)
+
+
+@pytest.fixture(scope="module")
+def mini_run(eight_devices, tmp_path_factory):
+    """One uninterrupted checkpointed run: 4 updates, saves at steps 2 and
+    4 — the shared baseline for the integrity and recovery tests."""
+    tmp = tmp_path_factory.mktemp("faults_baseline")
+    d = str(tmp / "ckpt")
+    trainer = _small_trainer(checkpoint_dir=d, checkpoint_every_steps=2)
+    trainer.run()
+    assert int(jax.device_get(trainer.state.step)) == 4
+    return trainer, d
+
+
+def test_manifests_written_and_verified_latest_step(mini_run):
+    from pytorch_distributed_training_tpu.train.checkpoint import (
+        latest_step,
+        verified_latest_step,
+    )
+
+    _, d = mini_run
+    assert latest_step(d) == 4
+    assert verified_latest_step(d) == 4
+    assert verified_latest_step(d, level="digest") == 4
+    for step in (2, 4):
+        sp = _step_path(d, step)
+        assert os.path.exists(os.path.join(sp, manifest.MANIFEST_NAME))
+        assert manifest.verify_step(sp, level="digest") == (True, "ok")
+
+
+def test_duplicate_save_skips_with_counter(mini_run, tmp_path):
+    from pytorch_distributed_training_tpu.train.checkpoint import Checkpointer
+
+    trainer, _ = mini_run
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        cp = Checkpointer(str(tmp_path / "dup"))
+        cp.save(trainer.state)
+        cp.wait()
+        cp.save(trainer.state)  # resume-then-periodic-save collision
+        cp.close()
+    finally:
+        set_registry(prev)
+    snap = reg.snapshot()
+    assert snap["counters"]["checkpoint/saves"] == 1  # one real save
+    assert snap["counters"]["checkpoint/duplicate_skips"] == 1  # no crash
+
+
+def test_restore_falls_back_to_newest_verified_step(mini_run, tmp_path):
+    from pytorch_distributed_training_tpu.train.checkpoint import Checkpointer
+
+    trainer, d = mini_run
+    work = str(tmp_path / "ckpt")
+    shutil.copytree(d, work)
+    corrupt_step_dir(_step_path(work, 4))  # same-size damage
+
+    cp = Checkpointer(work, verify="digest")
+    assert cp.latest_step() == 4  # orbax still lists the corrupt step
+    assert cp.verified_latest_step() == 2  # what restore will actually use
+    restored = cp.restore(trainer.state)
+    cp.close()
+    assert int(jax.device_get(restored.step)) == 2
+
+
+def test_restore_raises_when_nothing_verifies(mini_run, tmp_path):
+    from pytorch_distributed_training_tpu.train.checkpoint import (
+        CheckpointCorruptError,
+        Checkpointer,
+    )
+
+    trainer, d = mini_run
+    work = str(tmp_path / "ckpt")
+    shutil.copytree(d, work)
+    for step in (2, 4):
+        corrupt_step_dir(_step_path(work, step))
+    cp = Checkpointer(work, verify="digest")
+    assert cp.verified_latest_step() is None
+    with pytest.raises(CheckpointCorruptError, match="digest mismatch"):
+        cp.restore(trainer.state)
+    cp.close()
+
+
+def test_restore_accepts_manifestless_legacy_dir(mini_run, tmp_path):
+    from pytorch_distributed_training_tpu.train.checkpoint import Checkpointer
+
+    trainer, d = mini_run
+    work = str(tmp_path / "ckpt")
+    shutil.copytree(d, work)
+    for step in (2, 4):  # a pre-manifest-era directory
+        os.remove(os.path.join(_step_path(work, step), manifest.MANIFEST_NAME))
+    cp = Checkpointer(work)
+    restored = cp.restore(trainer.state)  # latest, with a warning — not a crash
+    cp.close()
+    assert int(jax.device_get(restored.step)) == 4
+
+
+def _load_verifier():
+    spec = importlib.util.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(REPO_ROOT, "scripts", "verify_checkpoint.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_verify_checkpoint_script_exit_codes(mini_run, tmp_path, capsys):
+    vc = _load_verifier()
+    _, d = mini_run
+    work = str(tmp_path / "ckpt")
+    shutil.copytree(d, work)
+
+    assert vc.main([work]) == 0  # clean dir: everything verifies
+    assert vc.main([work, "--strict"]) == 0
+    out = capsys.readouterr().out
+    assert "2/2 step(s) verified" in out
+
+    corrupt_step_dir(_step_path(work, 4))
+    assert vc.main([work]) == 0  # size-level is blind to same-size damage
+    assert vc.main([work, "--strict"]) == 2  # fallback step exists
+    out = capsys.readouterr().out
+    assert "restore would use: 2" in out
+    assert vc.main([work, "--strict", "--step", "2"]) == 0  # single step
+
+    corrupt_step_dir(_step_path(work, 2))
+    assert vc.main([work, "--strict", "--quiet"]) == 1  # nothing left
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert vc.main([str(empty)]) == 1
+    assert vc.main([str(tmp_path / "missing")]) == 1
+
+
+# --------------------------------------------------- end-to-end recovery (IT)
+
+
+def test_crash_at_step_supervised_restart_resumes_bitwise(
+    mini_run, eight_devices, tmp_path
+):
+    """Acceptance: crash after update 3, supervisor restarts, the resumed
+    attempt restores the step-2 checkpoint and must land on the SAME final
+    params and opt_state as the uninterrupted baseline — bitwise."""
+    import dataclasses
+
+    from pytorch_distributed_training_tpu.utils.supervisor import (
+        run_with_restarts,
+    )
+
+    baseline, _ = mini_run
+    d = str(tmp_path / "ckpt")
+    attempts = []
+    prev = set_plan(FaultPlan.parse("crash_at_step:3"))
+    try:
+        def attempt(i):
+            attempts.append(i)
+            trainer = _small_trainer(
+                checkpoint_dir=d, checkpoint_every_steps=2, resume=i > 0
+            )
+            trainer.run()
+            return trainer
+
+        trainer = run_with_restarts(
+            attempt, max_restarts=1, backoff_s=0.01, jitter=False,
+            checkpoint_dir=d,
+        )
+    finally:
+        set_plan(prev)
+    assert attempts == [0, 1]  # one injected crash, one successful resume
+    assert int(jax.device_get(trainer.state.step)) == 4
+    np.testing.assert_array_equal(
+        _flat(trainer.state.params), _flat(baseline.state.params)
+    )
+    np.testing.assert_array_equal(
+        _flat(trainer.state.opt_state), _flat(baseline.state.opt_state)
+    )
+
+
+def test_sigterm_emergency_checkpoint_and_resumable_exit(
+    eight_devices, tmp_path
+):
+    """Acceptance: SIGTERM mid-epoch → emergency checkpoint inside the
+    grace window, a `preemption` telemetry record, exit code 75 — and the
+    relaunched run resumes to completion."""
+    from pytorch_distributed_training_tpu.train.checkpoint import (
+        verified_latest_step,
+    )
+
+    d = str(tmp_path / "ckpt")
+    mdir = str(tmp_path / "metrics")
+    prev = set_plan(FaultPlan.parse("sigterm_at_step:2"))
+    try:
+        trainer = _small_trainer(checkpoint_dir=d, metrics_dir=mdir)
+        with pytest.raises(Preempted) as exc:
+            trainer.run()
+    finally:
+        set_plan(prev)
+    assert exc.value.code == RESUMABLE_EXIT_CODE
+    # the emergency save landed, committed, and verifies
+    assert verified_latest_step(d, level="digest") == 2
+
+    recs = _records(os.path.join(mdir, "metrics.jsonl"))
+    pre = [r for r in recs if r["record"] == "preemption"]
+    assert len(pre) == 1
+    assert pre[0]["signal"] == signal.SIGTERM
+    assert pre[0]["saved_step"] == 2
+    assert pre[0]["save_wall_s"] <= pre[0]["grace_s"]
+    assert any(r["record"] == "fault_injected" for r in recs)
+
+    # "resumable" is a promise: relaunching with resume continues to the end
+    resumed = _small_trainer(checkpoint_dir=d, resume=True)
+    assert int(jax.device_get(resumed.state.step)) == 2
+    history = resumed.run()
+    assert int(jax.device_get(resumed.state.step)) == 4
+    assert len(history) == 1
+
+
+def test_corrupt_ckpt_injection_falls_back_then_heals(
+    eight_devices, tmp_path
+):
+    """Acceptance: a run whose LATEST checkpoint is corrupted restores from
+    the newest verified step instead of crashing — and when training passes
+    the damaged step again, the duplicate-save guard re-saves over it
+    instead of skipping, so the directory heals."""
+    d = str(tmp_path / "ckpt")
+    mdir = str(tmp_path / "metrics")
+    prev = set_plan(FaultPlan.parse("corrupt_ckpt:latest"))
+    try:
+        first = _small_trainer(
+            checkpoint_dir=d, checkpoint_every_steps=2,
+            checkpoint_verify="digest",
+        )
+        first.run()  # Checkpointer.close() fires the injection on step 4
+    finally:
+        set_plan(prev)
+    assert manifest.verify_step(_step_path(d, 4), level="digest")[0] is False
+
+    resumed = _small_trainer(
+        checkpoint_dir=d, checkpoint_every_steps=2,
+        checkpoint_verify="digest", resume=True, metrics_dir=mdir,
+    )
+    # restore skipped the corrupt step 4 for verified step 2
+    assert int(jax.device_get(resumed.state.step)) == 2
+    resumed.run()
+    assert int(jax.device_get(resumed.state.step)) == 4
+
+    recs = _records(os.path.join(mdir, "metrics.jsonl"))
+    fb = [r for r in recs if r["record"] == "checkpoint_fallback"]
+    assert fb and fb[0]["latest_step"] == 4 and fb[0]["fallback_step"] == 2
+    # the re-trained step 4 replaced the damaged copy (checkpoint_resave)
+    assert any(r["record"] == "checkpoint_resave" for r in recs)
+    assert manifest.verify_step(_step_path(d, 4), level="digest") == (
+        True, "ok",
+    )
+    np.testing.assert_array_equal(
+        _flat(resumed.state.params), _flat(first.state.params)
+    )
+
+
+def test_hang_injection_wedges_until_watchdog_abort(tmp_path):
+    """hang_at_step blocks forever inside a watchdog-guarded section — the
+    failure that never raises. Driven in a daemon thread (a real run dies
+    by ``os._exit``; in-process we inject the exit and assert the code +
+    the abort record). The wedged thread stays parked, like a real hang."""
+    import threading
+
+    reg, sink = _reg_with_sink(tmp_path)
+    prev_reg = set_registry(reg)
+    aborted = threading.Event()
+    exits = []
+
+    def fake_exit(code):
+        exits.append(code)
+        aborted.set()
+
+    wd = Watchdog(
+        stall_factor=1.0, min_stall_s=0.05, hard_timeout_s=0.2,
+        _exit=fake_exit,
+    )
+    prev_wd = set_watchdog(wd)
+    plan = FaultPlan.parse("hang_at_step:2")
+    hang = threading.Thread(
+        target=plan.fire_step_fault, args=(2,), daemon=True
+    )
+    try:
+        hang.start()
+        assert aborted.wait(timeout=10), "watchdog never aborted the hang"
+    finally:
+        set_watchdog(prev_wd)
+        wd.close()
+        set_registry(prev_reg)
+        sink.close()
+    assert exits == [WATCHDOG_EXIT_CODE]
+    recs = _records(tmp_path / "metrics.jsonl")
+    assert any(r["record"] == "fault_injected" for r in recs)
+    abort = next(r for r in recs if r["record"] == "watchdog_abort")
+    assert abort["section"] == "injected_hang" and abort["step"] == 2
+
+
+# ------------------------------------------------------------- CLI contract
+
+
+def test_run_supervised_validates_restart_contract(mini_run):
+    """The shared CLI glue: --max-restarts demands a checkpoint dir, and a
+    dir already holding a checkpoint demands an explicit --resume (a retry
+    would otherwise silently continue a DIFFERENT run's trajectory)."""
+    from types import SimpleNamespace
+
+    from pytorch_distributed_training_tpu.cli import run_supervised
+    from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+    _, d = mini_run
+    args = SimpleNamespace(max_restarts=1, restart_window_s=0.0)
+
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        run_supervised(args, TrainConfig(), lambda cfg: None)
+    with pytest.raises(SystemExit, match="already holds"):
+        run_supervised(
+            args, TrainConfig(checkpoint_dir=d), lambda cfg: None
+        )
+
+    # resume makes the stale-dir guard moot; retries flip resume on
+    seen = []
+
+    def build(cfg):
+        seen.append(cfg.resume)
+        return SimpleNamespace(run=lambda: "history")
+
+    out = run_supervised(
+        args, TrainConfig(checkpoint_dir=d, resume=True), build
+    )
+    assert out == "history" and seen == [True]
