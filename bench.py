@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -432,6 +433,139 @@ def run_bench(
     }
 
 
+# --------------------------------------------------------------- quick mode
+# Input-pipeline A/B on CPU: prefetch-off vs prefetch-on through the REAL
+# Trainer (tiny synthetic task), plus a cold->warm --compile-cache-dir pair,
+# producing one comparison JSON. Each variant runs in its own subprocess
+# under JAX_PLATFORMS=cpu so the parent never initializes a backend and the
+# warm run exercises a true fresh-process cache load (the actual warm-start
+# story). Driven by the `perf`-marked pytest (tests/test_perf_pipeline.py),
+# kept out of tier-1 timing noise.
+
+
+def _quick_child(cfg_json: str) -> None:
+    """One quick-mode variant: tiny synthetic Trainer run, telemetry on."""
+    cfg = json.loads(cfg_json)
+    from pytorch_distributed_training_tpu.parallel import ShardingPolicy
+    from pytorch_distributed_training_tpu.train.loop import Trainer
+    from pytorch_distributed_training_tpu.utils.config import (
+        MeshConfig,
+        TrainConfig,
+        model_preset,
+    )
+
+    gb = cfg["global_batch"]
+    mcfg = model_preset("tiny", compute_dtype="float32")
+    tcfg = TrainConfig(
+        num_epochs=1,
+        global_batch_size=gb,
+        micro_batch_size=gb // 2,
+        eval_batch_size=gb,
+        train_size=gb * cfg["steps"],
+        eval_size=gb,
+        warmup_steps=4,
+        log_every=0,
+        bf16=False,
+        prefetch_depth=cfg["prefetch_depth"],
+        metrics_dir=cfg["metrics_dir"],
+        compile_cache_dir=cfg.get("compile_cache_dir"),
+    )
+    Trainer(
+        mcfg, tcfg, MeshConfig(), ShardingPolicy(), task="synthetic"
+    ).run()
+
+
+def _quick_stats(metrics_dir: str) -> dict:
+    """Fold one variant's stream: steady-state data wait + compile record."""
+    records = []
+    with open(os.path.join(metrics_dir, "metrics.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    steps = [r for r in records if r.get("record") == "step"]
+    # steady state: drop the pipeline-fill first step
+    steady = steps[1:] if len(steps) > 1 else steps
+    waits = [s["data_wait_s"] for s in steady]
+    occs = [s["prefetch_occupancy"] for s in steady
+            if "prefetch_occupancy" in s]
+    compile_rec = next(
+        (r for r in records if r.get("record") == "compile"), None
+    )
+    return {
+        "steps": len(steps),
+        "steady_steps": len(steady),
+        "data_wait_mean_s": sum(waits) / len(waits) if waits else None,
+        "data_wait_total_s": sum(waits),
+        "prefetch_occupancy_mean": sum(occs) / len(occs) if occs else None,
+        "compile_s": compile_rec.get("compile_s") if compile_rec else None,
+        "cache_hit": compile_rec.get("cache_hit") if compile_rec else None,
+        "compile_inclusive_steps": sum(
+            1 for s in steps if s.get("compile_inclusive")
+        ),
+    }
+
+
+def run_quick(steps: int = 24, global_batch: int = 64,
+              out_path: str | None = None) -> dict:
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="bench_quick_")
+    cache_dir = os.path.join(work, "compile_cache")
+    variants = {
+        "prefetch_off": dict(prefetch_depth=0),
+        "prefetch_on": dict(prefetch_depth=2),
+    }
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single CPU device, no forced SPMD mesh
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+    stats = {}
+    for name, extra in variants.items():
+        mdir = os.path.join(work, name)
+        cfg = dict(
+            steps=steps, global_batch=global_batch, metrics_dir=mdir,
+            compile_cache_dir=cache_dir, **extra,
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--quick-child", json.dumps(cfg)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"quick variant {name!r} failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        stats[name] = _quick_stats(mdir)
+    off, on = stats["prefetch_off"], stats["prefetch_on"]
+    result = {
+        "metric": (
+            f"input-pipeline quick bench (tiny synthetic, CPU, "
+            f"{steps} steps x batch {global_batch})"
+        ),
+        "prefetch_off": off,
+        "prefetch_on": on,
+        "data_wait_reduction_s": (
+            off["data_wait_mean_s"] - on["data_wait_mean_s"]
+            if off["data_wait_mean_s"] is not None
+            and on["data_wait_mean_s"] is not None
+            else None
+        ),
+        "warm_start": {
+            # run 1 compiled cold, run 2 (same jit keys, new process) warm
+            "cold_compile_s": off["compile_s"],
+            "warm_compile_s": on["compile_s"],
+            "cache_hit_second_run": on["cache_hit"],
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--model", default="bert-large-cased")
@@ -463,7 +597,29 @@ def main(argv=None):
     p.add_argument("--probe-budget-s", type=float, default=600.0,
                    help="total budget (s) for the subprocess backend probe "
                         "before declaring the tunnel down (0 = skip probe)")
+    p.add_argument("--quick", action="store_true",
+                   help="input-pipeline A/B on CPU: prefetch off vs on "
+                        "through the real Trainer + cold->warm compile-"
+                        "cache pair; writes a comparison JSON (no TPU, "
+                        "no probe)")
+    p.add_argument("--quick-steps", type=int, default=24)
+    p.add_argument("--quick-batch", type=int, default=64)
+    p.add_argument("--quick-out", default=None,
+                   help="where --quick writes its comparison JSON "
+                        "(default: print only)")
+    p.add_argument("--quick-child", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    if args.quick_child:
+        _quick_child(args.quick_child)
+        return {"quick_child": True}
+    if args.quick:
+        result = run_quick(
+            steps=args.quick_steps, global_batch=args.quick_batch,
+            out_path=args.quick_out,
+        )
+        print(json.dumps(result))
+        return result
 
     def failure_artifact(metric: str, error: dict) -> None:
         # Structured failure: one JSON line naming the cause, so a

@@ -1,4 +1,8 @@
 from pytorch_distributed_training_tpu.data.pipeline import ShardedLoader
+from pytorch_distributed_training_tpu.data.prefetch import (
+    PrefetchingIterator,
+    PrefetchingLoader,
+)
 from pytorch_distributed_training_tpu.data.glue import load_task_arrays
 from pytorch_distributed_training_tpu.data.bpe import (
     ByteLevelBPETokenizer,
@@ -8,6 +12,8 @@ from pytorch_distributed_training_tpu.data.bpe import (
 
 __all__ = [
     "ShardedLoader",
+    "PrefetchingIterator",
+    "PrefetchingLoader",
     "load_task_arrays",
     "ByteLevelBPETokenizer",
     "ByteTokenizer",

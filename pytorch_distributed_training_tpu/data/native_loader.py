@@ -145,6 +145,28 @@ class NativeShardedLoader:
     def steps_per_epoch(self) -> int:
         return self._n_epoch_rows // self.global_batch
 
+    def batch_spec(self) -> dict:
+        """Abstract (global) shapes/dtypes of one yielded batch (all int32 —
+        the C++ assembler's storage dtype); the AOT warm-start contract
+        shared with ``ShardedLoader.batch_spec``."""
+        micro_global = self.global_batch // self.accum
+        if self.train:
+            return {
+                k: jax.ShapeDtypeStruct(
+                    (self.accum, micro_global, *self._row_shapes[i]),
+                    np.int32,
+                )
+                for i, k in enumerate(self._keys)
+            }
+        spec = {
+            k: jax.ShapeDtypeStruct(
+                (self.global_batch, *self._row_shapes[i]), np.int32
+            )
+            for i, k in enumerate(self._keys)
+        }
+        spec["valid"] = jax.ShapeDtypeStruct((self.global_batch,), np.int32)
+        return spec
+
     def epoch(self, epoch_index: int = 0) -> Iterator[dict]:
         lib = self._lib
         if self.train:
@@ -157,8 +179,10 @@ class NativeShardedLoader:
                 dtype=np.int64,
             )
         else:
-            # identity order, row-0 pad entries (masked via ``valid``)
-            perm = np.zeros(self._n_epoch_rows, np.int64)
+            # identity order; pad entries re-gather the LAST valid row (same
+            # contract as pipeline.ShardedLoader._eval_epoch — masked off
+            # via ``valid``, and a hot-in-cache read instead of row 0)
+            perm = np.full(self._n_epoch_rows, self.n - 1, np.int64)
             perm[: self.n] = np.arange(self.n, dtype=np.int64)
         n_steps = lib.batcher_start_epoch(
             self._handle, perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))

@@ -118,6 +118,32 @@ class ShardedLoader:
             return self.n // self.global_batch
         return math.ceil(self.n / self.global_batch)
 
+    def batch_spec(self) -> dict:
+        """Abstract (global) shapes/dtypes of one yielded batch — what AOT
+        warm-start (train/compile.py) lowers the steps against. Shared
+        contract with ``NativeShardedLoader.batch_spec`` (which serves
+        int32 regardless of the source dtype)."""
+        import jax
+
+        if self.train:
+            micro_global = self.global_batch // self.accum
+            return {
+                k: jax.ShapeDtypeStruct(
+                    (self.accum, micro_global, *np.asarray(v).shape[1:]),
+                    np.asarray(v).dtype,
+                )
+                for k, v in self.data.items()
+            }
+        spec = {
+            k: jax.ShapeDtypeStruct(
+                (self.global_batch, *np.asarray(v).shape[1:]),
+                np.asarray(v).dtype,
+            )
+            for k, v in self.data.items()
+        }
+        spec["valid"] = jax.ShapeDtypeStruct((self.global_batch,), np.int32)
+        return spec
+
     def epoch(self, epoch_index: int = 0) -> Iterator[dict]:
         if self.train:
             yield from self._train_epoch(epoch_index)
@@ -159,7 +185,13 @@ class ShardedLoader:
             idx_global = np.arange(lo, min(lo + self.global_batch, self.n))
             valid_n = len(idx_global)
             if valid_n < self.global_batch:  # pad the ragged tail
-                pad = np.zeros(self.global_batch - valid_n, np.int64)
+                # pad with the LAST valid row, not row 0: padding with index
+                # 0 re-read row 0 up to global_batch-1 times per epoch; the
+                # last row is already hot in cache, and the ``valid`` mask
+                # zeroes the pad rows out of every metric either way
+                pad = np.full(
+                    self.global_batch - valid_n, self.n - 1, np.int64
+                )
                 idx_global = np.concatenate([idx_global, pad])
             local_sel = idx_global[self.pidx * per_host : (self.pidx + 1) * per_host]
             batch = {k: v[local_sel] for k, v in self.data.items()}

@@ -1,0 +1,211 @@
+"""Latency-hiding prefetch over either loader engine.
+
+The loaders (``pipeline.ShardedLoader`` and the C++-backed
+``NativeShardedLoader``) run host assembly + ``make_global_batch`` placement
+synchronously inside the Trainer's step loop: the accelerator sits idle for
+the whole assemble→place window between steps, and the H2D transfer for
+batch ``i+1`` cannot start until step ``i``'s dispatch returns. This module
+moves that work onto a background thread with a bounded depth-``k`` queue:
+while step ``i`` computes, batches ``i+1..i+k`` are assembled and placed
+(JAX dispatches their H2D transfers asynchronously), so in steady state the
+consumer's wait is a queue pop, not a full batch build — the role the
+reference delegated to PyTorch ``DataLoader`` workers
+(test_data_parallelism.py:102-107), owned TPU-natively here.
+
+Contract:
+
+- **Ordering is bitwise-identical** to the unwrapped loader: one worker,
+  one FIFO queue — the consumer sees exactly the epoch stream the inner
+  engine produced (mid-epoch resume's skip-first-N batches keeps working).
+- **Exceptions propagate**: a worker-side error is re-raised at the
+  consumer's next ``__next__`` call, not swallowed in a dead thread.
+- **Shutdown is clean**: ``close()`` (or abandoning the iterator) stops the
+  worker, drains queued batches, joins the thread and closes the inner
+  generator so engine resources (native ring slots) are released — the
+  Trainer's ``finally`` path (preemption exit 75, injected crashes, watchdog
+  aborts) closes through the same API it uses for bare loaders.
+
+Telemetry (per consumer pop, into the default registry):
+
+- ``data/prefetch_occupancy`` — ready batches in the queue at pop time
+  (depth = fully hidden; 0 = the consumer is about to stall);
+- ``data/prefetch_stall_s`` + counter ``data/prefetch_stalls`` — time spent
+  waiting on an empty queue (the producer fell behind the device).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from time import perf_counter
+from typing import Iterator
+
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
+
+_ITEM, _DONE, _ERROR = 0, 1, 2
+
+
+class PrefetchingIterator:
+    """Bounded background iteration over one epoch's batch stream."""
+
+    def __init__(self, source: Iterator, depth: int, *, name: str = "batch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._src = source
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._finished = False  # consumer saw _DONE/_ERROR
+        self._closed = False
+        self.last_occupancy = 0
+        self.last_wait_s = 0.0
+        self._thread = threading.Thread(
+            target=self._work, name=f"prefetch-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+
+    def _put(self, msg) -> bool:
+        """Enqueue, staying responsive to close(); False = told to stop."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self) -> None:
+        try:
+            for item in self._src:
+                if not self._put((_ITEM, item)):
+                    return
+            self._put((_DONE, None))
+        except BaseException as e:  # noqa: BLE001 — re-raised at the consumer
+            self._put((_ERROR, e))
+        finally:
+            # the generator's finally (native ring-slot release, telemetry)
+            # runs HERE, on the thread that advanced it
+            close = getattr(self._src, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- consumer
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished or self._closed:
+            raise StopIteration
+        reg = get_registry()
+        occupancy = self._q.qsize()
+        t0 = perf_counter()
+        while True:
+            try:
+                kind, val = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._closed or not self._thread.is_alive():
+                    # a worker that died without posting a sentinel (killed
+                    # interpreter teardown) must not hang the consumer
+                    if self._q.qsize():
+                        continue
+                    raise StopIteration from None
+        if kind == _ITEM:
+            wait = perf_counter() - t0
+            self.last_occupancy = occupancy
+            self.last_wait_s = wait
+            reg.observe("data/prefetch_occupancy", float(occupancy))
+            if occupancy == 0:
+                reg.inc("data/prefetch_stalls")
+                reg.observe("data/prefetch_stall_s", wait)
+            return val
+        self._finished = True
+        if kind == _ERROR:
+            raise val
+        raise StopIteration
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        """Stop the worker, drain the queue, join — idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # unblock a producer waiting on a full queue
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PrefetchingLoader:
+    """Drop-in wrapper giving any loader engine a prefetched ``epoch()``.
+
+    Proxies the shared loader surface (``steps_per_epoch``, ``batch_spec``,
+    ``close``); each ``epoch(i)`` returns a ``PrefetchingIterator`` over the
+    inner engine's stream for that epoch. Starting a new epoch retires the
+    previous epoch's iterator (a half-consumed one left by an exception
+    path must not keep its worker alive).
+    """
+
+    def __init__(self, inner, *, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.inner = inner
+        self.depth = depth
+        self._active: PrefetchingIterator | None = None
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.inner.steps_per_epoch
+
+    def batch_spec(self):
+        return self.inner.batch_spec()
+
+    @property
+    def last_occupancy(self) -> int:
+        return self._active.last_occupancy if self._active else 0
+
+    @property
+    def last_wait_s(self) -> float:
+        return self._active.last_wait_s if self._active else 0.0
+
+    def epoch(self, epoch_index: int = 0) -> PrefetchingIterator:
+        self._retire()
+        self._active = PrefetchingIterator(
+            self.inner.epoch(epoch_index), self.depth,
+            name=f"epoch{epoch_index}",
+        )
+        return self._active
+
+    def _retire(self) -> None:
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+
+    def close(self) -> None:
+        self._retire()
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

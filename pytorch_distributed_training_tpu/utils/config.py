@@ -376,6 +376,26 @@ class TrainConfig:
     # batcher (native/src/batcher.cpp) when a toolchain is available, else
     # the Python loader; "on" requires it; "off" forces the Python loader.
     native_loader: str = "auto"
+    # Latency-hiding input pipeline (data/prefetch.py): a background thread
+    # runs host assembly + device placement for the NEXT prefetch_depth
+    # train batches while the current step computes, so H2D transfers
+    # overlap device time instead of serializing in front of each dispatch.
+    # Batch order is bitwise-identical to the unwrapped loader. 0 = today's
+    # synchronous assemble->place->dispatch path.
+    prefetch_depth: int = 2
+    # Persistent XLA compilation cache (train/compile.py): when set, every
+    # jit compile in the process is cached under this directory and a
+    # second run with the same config skips XLA entirely (the `compile`
+    # telemetry record carries a cache-hit flag). Share the dir across
+    # runs/restarts of the same recipe.
+    compile_cache_dir: str | None = None
+    # AOT warm start: .lower().compile() the train/eval steps before epoch
+    # 0, so the first step is a normal steady-state step (no
+    # compile_inclusive flag) and compile wall time is attributed to its
+    # own `compile` telemetry record. Skipped automatically for custom
+    # train_step_factory schedules, chain_steps > 1 and seq-sharded meshes
+    # (their batch layouts are owned elsewhere).
+    aot_warmup: bool = True
     # Optimizer steps fused per dispatch (train/step.py): ONE compiled call
     # executes chain_steps updates back-to-back on device over a pre-stacked
     # [chain_steps, accum, micro, ...] batch. Amortizes host dispatch

@@ -40,7 +40,7 @@ def load_records(path: str) -> list[dict]:
 
 
 def summarize(records: list[dict]) -> dict:
-    """Fold the stream into {run, epochs: [per-epoch rows]}."""
+    """Fold the stream into {run, epochs: [per-epoch rows], compile}."""
     meta = next((r for r in records if r.get("record") == "run_meta"), {})
     steps_by_epoch: dict[int, list[dict]] = {}
     for r in records:
@@ -48,6 +48,7 @@ def summarize(records: list[dict]) -> dict:
             steps_by_epoch.setdefault(int(r.get("epoch", 0)), []).append(r)
     saves = [r for r in records if r.get("record") == "checkpoint_save"]
     restarts = [r for r in records if r.get("record") == "restart"]
+    compiles = [r for r in records if r.get("record") == "compile"]
 
     epochs = []
     for r in records:
@@ -58,6 +59,11 @@ def summarize(records: list[dict]) -> dict:
         total_step = sum(s.get("step_s", 0.0) for s in steps)
         total_wait = sum(s.get("data_wait_s", 0.0) for s in steps)
         straggler = r.get("straggler") or {}
+        # prefetch pipeline health: occupancy histogram + stall counter out
+        # of the epoch's telemetry window (present when --prefetch-depth>0)
+        tel = r.get("telemetry") or {}
+        occ = (tel.get("timers") or {}).get("data/prefetch_occupancy") or {}
+        stalls = (tel.get("counters") or {}).get("data/prefetch_stalls")
         row = {
             "epoch": epoch,
             "steps": len(steps),
@@ -66,12 +72,25 @@ def summarize(records: list[dict]) -> dict:
             "data_wait_pct": 100.0 * total_wait / total_step
             if total_step
             else None,
+            "prefetch_occupancy_mean": occ.get("mean_s"),
+            "prefetch_stalls": stalls,
             "slowest_host": straggler.get("slowest_host"),
             "wait_skew_s": straggler.get("wait_skew_s"),
             "accuracy": r.get("accuracy"),
             "eval_loss": r.get("eval_loss"),
         }
         epochs.append(row)
+    compile_summary = None
+    if compiles:
+        last = compiles[-1]
+        compile_summary = {
+            "count": len(compiles),
+            "total_s": sum(c.get("compile_s", 0.0) for c in compiles),
+            "train_compile_s": last.get("train_compile_s"),
+            "eval_compile_s": last.get("eval_compile_s"),
+            "cache_hit": last.get("cache_hit"),
+            "cache_dir": last.get("cache_dir"),
+        }
     return {
         "run": {
             "mesh_shape": meta.get("mesh_shape"),
@@ -79,6 +98,7 @@ def summarize(records: list[dict]) -> dict:
             "jax_version": meta.get("jax_version"),
         },
         "epochs": epochs,
+        "compile": compile_summary,
         "checkpoint_saves": len(saves),
         "restarts": len(restarts),
     }
@@ -99,6 +119,8 @@ def render_table(summary: dict) -> str:
         ("train_loss", "loss"),
         ("samples_per_sec_per_chip", "samp/s/chip"),
         ("data_wait_pct", "data-wait %"),
+        ("prefetch_occupancy_mean", "pf-occ"),
+        ("prefetch_stalls", "pf-stall"),
         ("slowest_host", "slow host"),
         ("wait_skew_s", "skew s"),
         ("accuracy", "acc"),
@@ -120,6 +142,15 @@ def render_table(summary: dict) -> str:
         f"ckpt_saves={summary['checkpoint_saves']} "
         f"restarts={summary['restarts']}"
     )
+    comp = summary.get("compile")
+    if comp:
+        hit = comp.get("cache_hit")
+        lines.append(
+            f"compile: {_fmt(comp.get('total_s'))}s "
+            f"(train {_fmt(comp.get('train_compile_s'))}s, "
+            f"eval {_fmt(comp.get('eval_compile_s'))}s, "
+            f"cache={'hit' if hit else 'miss' if hit is not None else 'off'})"
+        )
     return "\n".join(lines)
 
 

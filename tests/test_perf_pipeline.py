@@ -1,0 +1,53 @@
+"""Input-pipeline performance acceptance (opt-in: ``-m perf``).
+
+Drives ``bench.py --quick``: two subprocess runs of the real Trainer on a
+tiny synthetic CPU workload — prefetch off (cold compile cache) then
+prefetch on (warm cache) — and asserts the PR's wins on the resulting
+comparison JSON: steady-state data wait strictly lower with prefetch on,
+no compile-inclusive steps after AOT warm start, and the second run's
+compile served from the persistent cache in less wall time. Timing-based
+by nature, so it stays out of tier-1 (conftest auto-skips without
+``-m perf``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.perf
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_quick_bench_prefetch_and_warm_start(tmp_path):
+    out = tmp_path / "comparison.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--quick", "--quick-steps", "20", "--quick-out", str(out),
+        ],
+        capture_output=True, text=True, timeout=1200,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    cmp = json.loads(out.read_text())
+    off, on = cmp["prefetch_off"], cmp["prefetch_on"]
+
+    # the latency-hiding win: steady-state data wait strictly lower
+    assert on["data_wait_mean_s"] < off["data_wait_mean_s"], cmp
+    assert cmp["data_wait_reduction_s"] > 0
+    assert on["prefetch_occupancy_mean"] is not None
+    assert off["prefetch_occupancy_mean"] is None  # depth 0 = unwrapped
+
+    # AOT warm start: compilation never lands inside a step
+    assert off["compile_inclusive_steps"] == 0
+    assert on["compile_inclusive_steps"] == 0
+    assert off["compile_s"] > 0 and on["compile_s"] > 0
+
+    # warm start: second run hits the persistent cache, compiles faster
+    ws = cmp["warm_start"]
+    assert ws["cache_hit_second_run"] is True, cmp
+    assert ws["warm_compile_s"] < ws["cold_compile_s"], cmp

@@ -295,12 +295,46 @@ def test_stream_step_records_breakdown(metrics_run):
         # total covers its parts (measured against the same perf_counter)
         assert s["step_s"] >= s["device_block_s"]
         assert math.isfinite(s["loss"])
-    # the first step carries compilation; steady state doesn't
-    assert steps[0]["compile_inclusive"] is True
-    assert all(s["compile_inclusive"] is False for s in steps[1:])
+        # default prefetch pipeline annotates queue occupancy per step
+        assert 0 <= s["prefetch_occupancy"] <= 2
+    # AOT warm start moved compilation OUT of the step stream: no step is
+    # compile-inclusive, and the compile wall time has its own record
+    assert all(s["compile_inclusive"] is False for s in steps)
     assert [s["step"] for s in steps] == list(
         range(1, len(steps) + 1)
     )
+
+
+def test_stream_compile_record_from_aot_warm_start(metrics_run):
+    _, records, _ = metrics_run
+    compiles = [r for r in records if r["record"] == "compile"]
+    assert len(compiles) == 1
+    c = compiles[0]
+    assert c["aot"] is True
+    assert c["train_compile_s"] > 0
+    assert c["eval_compile_s"] > 0
+    assert c["compile_s"] == pytest.approx(
+        c["train_compile_s"] + c["eval_compile_s"]
+    )
+    assert c["cache_hit"] is None  # no --compile-cache-dir in this run
+
+
+def test_lazy_compile_path_flags_first_step(eight_devices, tmp_path):
+    """aot_warmup=False keeps the legacy behavior: the first step carries
+    compilation and is flagged, later steps aren't."""
+    mdir = str(tmp_path / "lazy")
+    trainer = _small_trainer(
+        metrics_dir=mdir, aot_warmup=False, train_size=64
+    )
+    trainer.run()
+    records = [
+        json.loads(l)
+        for l in open(os.path.join(mdir, "metrics.jsonl")).read().splitlines()
+    ]
+    steps = [r for r in records if r["record"] == "step"]
+    assert steps[0]["compile_inclusive"] is True
+    assert all(s["compile_inclusive"] is False for s in steps[1:])
+    assert not [r for r in records if r["record"] == "compile"]
 
 
 def test_stream_epoch_record_matches_history(metrics_run):
