@@ -433,6 +433,216 @@ def run_bench(
     }
 
 
+# --------------------------------------------------------------- serve mode
+# Closed-loop serving load generator on CPU: N client threads drive the
+# continuous-batching engine (serve/) over a configurable prompt-length mix,
+# against a sequential one-shot generate() baseline on the SAME workload.
+# Writes BENCH_serve.json with throughput + latency percentiles. Runs in a
+# JAX_PLATFORMS=cpu subprocess (the --quick pattern) so the parent never
+# initializes a backend; driven by the `perf`+`serve`-marked pytest
+# (tests/test_serve_bench.py), kept out of tier-1 timing noise.
+
+
+def _serve_stats_mod():
+    """scripts/summarize_metrics.py as a module (scripts/ isn't a package)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "summarize_metrics.py",
+    )
+    spec = importlib.util.spec_from_file_location("summarize_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _ListSink:
+    """In-memory telemetry sink: the bench reads percentiles straight from
+    the records instead of round-tripping a JSONL file."""
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        self.records.append(rec)
+
+    def flush(self, **kw):
+        pass
+
+
+def _serve_child(cfg_json: str) -> None:
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.models.generate import generate
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.serve import (
+        BackpressureError,
+        EngineConfig,
+        InferenceServer,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = json.loads(cfg_json)
+    mix = cfg["prompt_mix"]
+    max_new = cfg["max_new"]
+    n_requests = cfg["requests"]
+
+    mcfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(mcfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"
+    ]
+    rng = np.random.default_rng(42)
+    prompts = [
+        rng.integers(1, mcfg.vocab_size, mix[i % len(mix)]).astype(np.int32)
+        for i in range(n_requests)
+    ]
+
+    # ---- sequential one-shot baseline (generate() per request, batch=1);
+    # warm each distinct prompt length first so compile stays out of both
+    # timed sections
+    warm = {
+        n: rng.integers(1, mcfg.vocab_size, n).astype(np.int32)
+        for n in sorted({len(p) for p in prompts})
+    }
+    for p in warm.values():
+        np.asarray(generate(model, params, p[None], max_new_tokens=max_new))
+    t0 = time.perf_counter()
+    seq_tokens = 0
+    for p in prompts:
+        out = np.asarray(generate(model, params, p[None],
+                                  max_new_tokens=max_new))
+        seq_tokens += out.shape[1] - len(p)
+    seq_wall = time.perf_counter() - t0
+
+    # ---- continuous-batching engine over the same workload
+    registry = MetricsRegistry()
+    sink = _ListSink()
+    registry.attach_sink(sink)
+    buckets = tuple(sorted({len(p) for p in prompts}))
+    server = InferenceServer(
+        model, params,
+        EngineConfig(num_slots=cfg["slots"], prompt_buckets=buckets,
+                     max_new_tokens=max_new),
+        queue_depth=cfg["queue_depth"], registry=registry,
+    ).start()
+    # warm every prefill bucket + the decode step before timing
+    for n in buckets:
+        server.submit(warm[n], max_new_tokens=2).done.wait()
+    sink.records.clear()
+
+    work = list(prompts)
+    lock = threading.Lock()
+    rejected = [0]
+
+    def client():
+        while True:
+            with lock:
+                if not work:
+                    return
+                p = work.pop()
+            while True:
+                try:
+                    req = server.submit(p, max_new_tokens=max_new)
+                    break
+                except BackpressureError:
+                    with lock:
+                        rejected[0] += 1
+                    time.sleep(0.002)
+            req.done.wait()
+
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(cfg["concurrency"])
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng_wall = time.perf_counter() - t0
+    server.close(drain=True)
+
+    serve_summary = _serve_stats_mod().summarize_serve(sink.records)
+    eng_tokens = serve_summary["tokens"]
+    result = {
+        "metric": (
+            f"serving quick bench (tiny LM, CPU, {n_requests} requests x "
+            f"{max_new} new tokens, prompt mix {mix}, "
+            f"{cfg['slots']} slots, {cfg['concurrency']} clients)"
+        ),
+        "engine": {
+            "tokens_per_s": round(eng_tokens / eng_wall, 2),
+            "wall_s": round(eng_wall, 3),
+            "tokens": eng_tokens,
+            "requests": serve_summary["done"],
+            "rejected_submits": rejected[0],
+            "slots": cfg["slots"],
+            "queue_depth": cfg["queue_depth"],
+            "ttft_s": serve_summary["ttft_s"],
+            "tpot_s": serve_summary["tpot_s"],
+            "queue_wait_s": serve_summary["queue_wait_s"],
+            "stats": server.stats(),
+        },
+        "sequential": {
+            "tokens_per_s": round(seq_tokens / seq_wall, 2),
+            "wall_s": round(seq_wall, 3),
+            "tokens": seq_tokens,
+        },
+        "speedup": round((eng_tokens / eng_wall) / (seq_tokens / seq_wall), 3),
+    }
+    print(json.dumps(result))
+
+
+def run_serve(
+    requests: int = 16,
+    concurrency: int = 6,
+    slots: int = 4,
+    max_new: int = 16,
+    prompt_mix=(6, 10, 14),
+    queue_depth: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+    cfg = dict(
+        requests=requests, concurrency=concurrency, slots=slots,
+        max_new=max_new, prompt_mix=list(prompt_mix),
+        queue_depth=queue_depth,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-child", json.dumps(cfg)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serve bench failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 # --------------------------------------------------------------- quick mode
 # Input-pipeline A/B on CPU: prefetch-off vs prefetch-on through the REAL
 # Trainer (tiny synthetic task), plus a cold->warm --compile-cache-dir pair,
@@ -608,11 +818,49 @@ def main(argv=None):
                    help="where --quick writes its comparison JSON "
                         "(default: print only)")
     p.add_argument("--quick-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--serve", action="store_true",
+                   help="closed-loop serving bench on CPU: the continuous-"
+                        "batching engine (serve/) vs sequential one-shot "
+                        "generate() over the same prompt mix; writes a "
+                        "throughput+latency-percentile JSON (no TPU, no "
+                        "probe)")
+    p.add_argument("--serve-requests", type=int, default=16)
+    p.add_argument("--serve-concurrency", type=int, default=6,
+                   help="closed-loop client threads")
+    p.add_argument("--serve-slots", type=int, default=4,
+                   help="engine decode slots")
+    p.add_argument("--serve-max-new", type=int, default=16)
+    p.add_argument("--serve-prompt-mix", default="6,10,14",
+                   help="comma-separated prompt lengths, cycled across "
+                        "requests")
+    p.add_argument("--serve-queue-depth", type=int, default=4,
+                   help="admission-queue depth (below concurrency so the "
+                        "backpressure path is exercised)")
+    p.add_argument("--serve-out", default="BENCH_serve.json",
+                   help="where --serve writes its JSON")
+    p.add_argument("--serve-child", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.quick_child:
         _quick_child(args.quick_child)
         return {"quick_child": True}
+    if args.serve_child:
+        _serve_child(args.serve_child)
+        return {"serve_child": True}
+    if args.serve:
+        result = run_serve(
+            requests=args.serve_requests,
+            concurrency=args.serve_concurrency,
+            slots=args.serve_slots,
+            max_new=args.serve_max_new,
+            prompt_mix=tuple(
+                int(n) for n in args.serve_prompt_mix.split(",") if n.strip()
+            ),
+            queue_depth=args.serve_queue_depth,
+            out_path=args.serve_out,
+        )
+        print(json.dumps(result))
+        return result
     if args.quick:
         result = run_quick(
             steps=args.quick_steps, global_batch=args.quick_batch,

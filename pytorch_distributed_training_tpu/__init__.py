@@ -26,6 +26,10 @@ Layout
                   metrics, checkpointing
 - ``data``      — GLUE pipelines with fixed-length padding, per-host sharding,
                   synthetic offline fallback
+- ``serve``     — continuous-batching inference: slotted KV-cache decode
+                  engine, bounded admission queue (backpressure/deadlines/
+                  bucket FIFO), stdio-JSONL + localhost-HTTP token-streaming
+                  front-ends
 - ``utils``     — configs, logging, profiling
 """
 

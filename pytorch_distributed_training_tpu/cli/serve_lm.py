@@ -1,0 +1,148 @@
+"""Serving entry point: continuous-batching LM inference (serve/).
+
+Turns a trained causal-LM checkpoint into a request server:
+
+    # stdin/JSONL mode (default): one request per line, token events out
+    echo '{"prompt": "The quick brown", "max_new_tokens": 16}' | \
+    python -m pytorch_distributed_training_tpu.cli.serve_lm \
+        --model gpt2-medium --checkpoint-dir /ckpts/run1 \
+        --vocab encoder.json --merges merges.txt --num-slots 8
+
+    # localhost HTTP mode: POST /generate streams JSONL token events;
+    # GET /healthz, GET /stats
+    python -m pytorch_distributed_training_tpu.cli.serve_lm \
+        --http-port 8000 --num-slots 8 --metrics-dir /tmp/serve_metrics
+
+Engine shape knobs: ``--num-slots`` fixed decode slots (the continuous
+batch), ``--prompt-buckets`` comma-separated prefill lengths (one
+compiled prefill per bucket; prompts pad up to the smallest fitting
+bucket), ``--max-new-tokens-cap`` bounds the KV cache (largest bucket +
+cap). Admission knobs: ``--queue-depth`` (beyond it, submissions are
+REJECTED with a backpressure error — JSONL ``error`` event / HTTP 429 —
+never queued unboundedly), ``--deadline-s`` default per-request deadline
+(queued requests past it expire without burning prefill).
+
+``--metrics-dir`` streams per-request ``serve_request`` records (TTFT,
+TPOT, queue wait) through telemetry/; fold them into a percentile table
+with ``scripts/summarize_metrics.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from pytorch_distributed_training_tpu.cli.generate_lm import add_model_args
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_model_args(p)
+    p.add_argument("--num-slots", type=int, default=4,
+                   help="fixed decode slots (concurrent in-flight requests)")
+    p.add_argument("--prompt-buckets", default="16,32,64,128",
+                   help="comma-separated prompt-length buckets; one compiled "
+                        "prefill program per bucket")
+    p.add_argument("--max-new-tokens-cap", type=int, default=64,
+                   help="per-request max_new_tokens ceiling; KV cache length "
+                        "= largest bucket + this cap")
+    p.add_argument("--queue-depth", type=int, default=16,
+                   help="admission-queue depth; submissions beyond it are "
+                        "rejected with a backpressure error")
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help="default per-request deadline (0 = none); queued "
+                        "requests past it expire unserved")
+    p.add_argument("--http-port", type=int, default=0,
+                   help="serve HTTP on 127.0.0.1:<port> (0 = stdin/JSONL "
+                        "mode)")
+    p.add_argument("--metrics-dir", default=None,
+                   help="stream serve telemetry (JSONL) under this directory")
+    return p
+
+
+def main(argv=None, in_stream=None, out_stream=None) -> dict:
+    """Run the server until EOF (stdio mode) or interrupt (HTTP mode);
+    returns the engine's final stats dict (machine-checkable in tests)."""
+    args = build_parser().parse_args(argv)
+
+    from pytorch_distributed_training_tpu.cli.generate_lm import (
+        build_tokenizer,
+        load_model_and_params,
+    )
+    from pytorch_distributed_training_tpu.serve import (
+        EngineConfig,
+        InferenceServer,
+        make_http_server,
+        serve_stdio,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        get_registry,
+    )
+    from pytorch_distributed_training_tpu.utils.logging import log0
+
+    tok = build_tokenizer(args)
+    model, params = load_model_and_params(args, tok)
+
+    registry = get_registry()
+    sink = None
+    if args.metrics_dir:
+        from pytorch_distributed_training_tpu.telemetry.sink import JsonlSink
+
+        sink = JsonlSink(args.metrics_dir)
+        registry.attach_sink(sink)
+        sink.emit({
+            "record": "serve_meta",
+            "model": args.model,
+            "num_slots": args.num_slots,
+            "prompt_buckets": args.prompt_buckets,
+            "max_new_tokens_cap": args.max_new_tokens_cap,
+            "queue_depth": args.queue_depth,
+        })
+
+    config = EngineConfig(
+        num_slots=args.num_slots,
+        prompt_buckets=tuple(
+            int(b) for b in args.prompt_buckets.split(",") if b.strip()
+        ),
+        max_new_tokens=args.max_new_tokens_cap,
+    )
+    server = InferenceServer(
+        model, params, config,
+        queue_depth=args.queue_depth,
+        default_deadline_s=args.deadline_s or None,
+        registry=registry,
+    ).start()
+
+    try:
+        if args.http_port:
+            httpd = make_http_server(
+                server, tok, port=args.http_port
+            )
+            log0(
+                f"serving on http://127.0.0.1:{httpd.server_address[1]} "
+                f"(POST /generate, GET /healthz, GET /stats)"
+            )
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:  # pragma: no cover - interactive stop
+                pass
+            finally:
+                httpd.shutdown()
+        else:
+            served = serve_stdio(
+                server, tok,
+                in_stream if in_stream is not None else sys.stdin,
+                out_stream if out_stream is not None else sys.stdout,
+            )
+            log0(f"stdio stream closed after {served} requests")
+    finally:
+        server.close(drain=True)
+        stats = server.stats()
+        if sink is not None:
+            sink.emit({"record": "serve_summary", **stats})
+            sink.flush(fsync=True)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

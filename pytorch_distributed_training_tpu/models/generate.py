@@ -17,10 +17,12 @@ module provides jitted prefill+decode generation over the KV cache that
 Prompt batches are right-padded. Each row's next-token distribution starts
 from its own last REAL prompt token (``prompt_lengths``), and pad positions
 are masked out of attention; continuations for every row are written at
-columns [prompt_len, prompt_len + max_new_tokens). Note the GPT-2 absolute
-position of generated tokens is the padded column index (the standard
-right-padding caveat — rows much shorter than the padded length see a
-positional gap; batch similar-length prompts together when that matters).
+columns [prompt_len, prompt_len + max_new_tokens). Decode steps pass
+per-row position ids (``prompt_lengths + t``) explicitly, so a generated
+token's GPT-2 absolute position continues from the row's REAL length, not
+the padded column index — ragged batches attend with correct positions
+(each row's continuation is identical to running it alone unpadded;
+pinned by tests/test_generate.py::test_padded_matches_exact_per_row).
 """
 
 from __future__ import annotations
@@ -177,6 +179,12 @@ def generate(
                 {"params": params, "cache": cache},
                 nxt[:, None],
                 mask_upto(t + 1),
+                # per-row positions: the generated token's absolute position
+                # continues from the row's REAL prompt length, not from the
+                # padded column it is stored at (right-padding positional
+                # gap fix) — for full-length rows this is exactly the value
+                # the cached pos_index would have supplied
+                position_ids=(prompt_lengths + t)[:, None],
                 mutable=["cache"],
             )
             return (

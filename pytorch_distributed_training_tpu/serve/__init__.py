@@ -1,0 +1,51 @@
+"""Continuous-batching inference subsystem (the north star's request path).
+
+Training (train/) and one-shot batch generation (models/generate.py) leave
+the repo with no way to SERVE a model; this package is that missing half:
+
+- ``engine``  — slotted KV-cache decode: a fixed ``[num_slots, ...]`` cache
+                (the flax "cache" collection with a vmapped slot axis), one
+                jitted prefill per prompt-length bucket, one jitted decode
+                step advancing every active slot per tick, admit/evict
+                between ticks (continuous batching, Orca-style; fixed slots
+                are the XLA-static-shape stand-in for paged KV blocks);
+- ``queue``   — bounded admission queue: ``BackpressureError`` at max
+                depth, per-request deadlines, FIFO-within-bucket
+                scheduling;
+- ``server``  — the serve-loop thread plus stdin/JSONL and localhost HTTP
+                front-ends that stream tokens back per request.
+
+Observability and failure handling ride the existing subsystems:
+per-request TTFT/TPOT/queue-wait records and queue-depth/slot-occupancy
+gauges go through ``telemetry/`` (``scripts/summarize_metrics.py``
+renders the serving percentile table), prefill/decode dispatch is armed
+under the ``faults/`` watchdog, and ``PDT_TPU_FAULT=slow_host:<f>x``
+stretches tick time deterministically to drill deadline/backpressure
+paths. ``bench.py --serve`` is the closed-loop load generator.
+"""
+
+from pytorch_distributed_training_tpu.serve.engine import (
+    DecodeEngine,
+    EngineConfig,
+)
+from pytorch_distributed_training_tpu.serve.queue import (
+    BackpressureError,
+    GenRequest,
+    RequestQueue,
+)
+from pytorch_distributed_training_tpu.serve.server import (
+    InferenceServer,
+    make_http_server,
+    serve_stdio,
+)
+
+__all__ = [
+    "BackpressureError",
+    "DecodeEngine",
+    "EngineConfig",
+    "GenRequest",
+    "InferenceServer",
+    "RequestQueue",
+    "make_http_server",
+    "serve_stdio",
+]

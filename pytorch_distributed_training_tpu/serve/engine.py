@@ -1,0 +1,490 @@
+"""Continuous-batching decode engine with slotted KV cache.
+
+The one-shot ``models/generate.py`` path compiles a whole
+prefill+scan program per (batch, prompt_len, max_new_tokens) triple and
+holds every request in lockstep — fine for offline batch generation,
+wrong for a server where requests arrive at different times with
+different lengths. This engine is the serving counterpart (continuous
+batching a la Orca; fixed decode slots standing in for vLLM's paged KV
+blocks, which is the shape XLA's static-shape constraint wants):
+
+- The KV cache is ONE resident pytree of ``[num_slots, 1, cache_len,
+  heads, head_dim]`` buffers (plus per-slot ``cache_index``/``pos_index``
+  scalars) — the flax "cache" collection that
+  ``BertSelfAttention._cached_attend`` maintains, with a leading slot
+  axis added by ``jax.vmap``.
+- **Prefill into a slot**: one jitted program per prompt-length *bucket*
+  (compilation stays bounded by the bucket list, not by observed prompt
+  lengths). The prompt is right-padded to its bucket, run through the
+  decode model batch-1, and the slot's index variables are then patched
+  to the REAL prompt length — so decode continues at the correct
+  position with the correct position embeddings (no right-padding
+  positional gap), and pad K/V entries are overwritten by generated
+  tokens exactly one step before the causal mask would first expose
+  them.
+- **Decode tick**: ONE jitted, slot-vmapped single-token step advances
+  every active slot together; per-slot index scalars (vmap carries them
+  as ``[num_slots]`` vectors) give each slot its own sequence position.
+  Inactive slots compute too (static shapes) but their cache is
+  bit-frozen via ``where(active, new, old)``.
+- Between ticks the engine admits queued requests into free slots and
+  evicts finished ones — a new request's prefill simply overwrites the
+  slot row (stale K/V beyond the patched index is never visible, by the
+  same one-step-ahead argument as padding).
+
+Sampling runs on the host from fp32 logits: greedy is ``np.argmax``
+(token-identical to ``generate()``'s in-jit argmax — acceptance pins
+this bitwise on ids), temperature>0 draws from a per-request
+``jax.random`` stream folded with the step index. Host-side sampling
+costs one small D2H per tick; on CPU serving (this PR's test target)
+that is noise — a TPU deployment would move sampling on-device, which
+slots in behind the same tick API.
+
+Integration: prefill/decode dispatch+block run under
+``faults.watchdog_guard`` (a wedged device hangs the serve loop exactly
+like a training collective); each tick routes through
+``FaultPlan.slow_host_delay`` so ``PDT_TPU_FAULT=slow_host:<f>x``
+stretches serving time deterministically (deadline/backpressure drills);
+per-request TTFT/TPOT/queue-wait and tick-level queue-depth/slot-
+occupancy go through ``telemetry/`` (JSONL via the process-0-gated sink).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
+from pytorch_distributed_training_tpu.serve.queue import GenRequest, RequestQueue
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Decode-engine shape knobs (everything that fixes compiled programs).
+
+    ``cache_len`` (largest bucket + ``max_new_tokens``) bounds every
+    request: a request needs ``bucket(prompt) + max_new_tokens <=
+    cache_len``, which holds by construction since per-request
+    ``max_new_tokens`` is capped at the config value.
+    """
+
+    num_slots: int = 4
+    prompt_buckets: tuple = (16, 32, 64)
+    max_new_tokens: int = 64
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+        self.prompt_buckets = tuple(sorted(set(int(b) for b in self.prompt_buckets)))
+        if not self.prompt_buckets or self.prompt_buckets[0] < 1:
+            raise ValueError(
+                f"prompt_buckets must be positive lengths, got "
+                f"{self.prompt_buckets!r}"
+            )
+
+    @property
+    def cache_len(self) -> int:
+        return self.prompt_buckets[-1] + self.max_new_tokens
+
+
+def _patch_index_vars(cache, value):
+    """Set every ``cache_index``/``pos_index`` leaf (the flax cache's scalar
+    position state) to ``value`` — the one place the engine steers WHERE the
+    next token lands and WHICH position embedding it gets."""
+    def fix(path, leaf):
+        key = getattr(path[-1], "key", None)
+        if key in ("cache_index", "pos_index"):
+            return jnp.asarray(value).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Engine-private per-slot state between ticks."""
+
+    request: GenRequest
+    pending_token: int          # sampled, not yet fed through decode
+    steps_done: int = 0         # decode steps already executed for this slot
+
+
+class DecodeEngine:
+    """Slotted continuous-batching decode over a causal LM.
+
+    Single-threaded by contract: ``tick``/``cancel_all`` run on the serve
+    loop thread (serve/server.py); construction may happen anywhere.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        config: EngineConfig,
+        queue: RequestQueue,
+        *,
+        registry=None,
+    ):
+        cfg = model.config
+        if not cfg.causal:
+            raise ValueError("DecodeEngine needs a causal model")
+        if cfg.scan_layers:
+            # serve loops are exactly the "hot serving" case the generate()
+            # docstring defers: unstack ONCE at engine build, not per call
+            from pytorch_distributed_training_tpu.models.relayout import (
+                unstack_scanned_params,
+            )
+
+            cfg = dataclasses.replace(cfg, scan_layers=False)
+            model = type(model)(cfg)
+            params = unstack_scanned_params(params)
+        self.config = config
+        if config.cache_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"cache_len {config.cache_len} (= largest bucket "
+                f"{config.prompt_buckets[-1]} + max_new_tokens "
+                f"{config.max_new_tokens}) exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}"
+            )
+        self._decode_model = type(model)(dataclasses.replace(cfg, decode=True))
+        self._params = params
+        self._queue = queue
+        if registry is None:
+            from pytorch_distributed_training_tpu.telemetry.registry import (
+                get_registry,
+            )
+
+            registry = get_registry()
+        self._registry = registry
+
+        # Per-slot cache template comes from a batch-1 abstract init at the
+        # full cache length (no params materialized); the resident cache
+        # stacks it on a leading [num_slots] axis.
+        shapes = jax.eval_shape(
+            lambda: self._decode_model.init(
+                jax.random.key(0),
+                jnp.ones((1, config.cache_len), jnp.int32),
+            )
+        )["cache"]
+        self._cache = jax.tree.map(
+            lambda s: jnp.zeros((config.num_slots,) + s.shape, s.dtype),
+            shapes,
+        )
+        self._slots: list[Optional[_Slot]] = [None] * config.num_slots
+        self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
+        self._decode_fn = None
+        self._last_logits = np.zeros(
+            (config.num_slots, cfg.vocab_size), np.float32
+        )
+        self.ticks = 0
+        self.admitted = 0
+        self.finished = 0
+
+    # -------------------------------------------------------------- compiled
+
+    def _prefill_fn(self, bucket: int):
+        """Jitted prefill-into-slot for one prompt bucket. Compiles once per
+        bucket (the queue only produces configured buckets)."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def prefill(params, cache, slot, ids, real_len):
+            # slot's private cache, position state reset for the new request
+            slot_cache = jax.tree.map(
+                lambda g: jax.lax.dynamic_index_in_dim(
+                    g, slot, 0, keepdims=False
+                ),
+                cache,
+            )
+            slot_cache = _patch_index_vars(slot_cache, 0)
+            # right-padded prompt, no explicit mask: pads sit AFTER the real
+            # tokens, so causal-over-cache masking already hides them from
+            # every real query; pad K/V entries are overwritten by generated
+            # tokens one step before the causal mask would expose them
+            logits, vars_ = self._decode_model.apply(
+                {"params": params, "cache": slot_cache},
+                ids,
+                mutable=["cache"],
+            )
+            new_slot = _patch_index_vars(vars_["cache"], real_len)
+            new_cache = jax.tree.map(
+                lambda g, p: jax.lax.dynamic_update_slice(
+                    g, p[None], (slot,) + (0,) * p.ndim
+                ),
+                cache,
+                new_slot,
+            )
+            last = jnp.take_along_axis(
+                logits, (real_len - 1)[None, None, None], axis=1
+            )[0, 0, :].astype(jnp.float32)
+            return last, new_cache
+
+        fn = jax.jit(prefill)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode_step_fn(self):
+        """ONE jitted program advancing every slot a single token: vmap over
+        the slot axis gives each slot its own cache_index/pos_index."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+
+        def one(params, slot_cache, token, active):
+            logits, vars_ = self._decode_model.apply(
+                {"params": params, "cache": slot_cache},
+                jnp.reshape(token, (1, 1)),
+                mutable=["cache"],
+            )
+            new_cache = jax.tree.map(
+                lambda n, o: jnp.where(active, n, o), vars_["cache"],
+                slot_cache,
+            )
+            return logits[0, 0, :].astype(jnp.float32), new_cache
+
+        self._decode_fn = jax.jit(
+            jax.vmap(one, in_axes=(None, 0, 0, 0))
+        )
+        return self._decode_fn
+
+    # -------------------------------------------------------------- sampling
+
+    def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
+        """Next token from fp32 logits. Greedy mirrors generate()'s argmax
+        (token-identical); temperature>0 draws from the request's own
+        deterministic stream (seed folded with the step index)."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        scaled = logits / req.temperature
+        if req.top_k > 0:
+            kth = np.sort(scaled)[-req.top_k]
+            scaled = np.where(scaled < kth, np.finfo(np.float32).min, scaled)
+        key = jax.random.fold_in(jax.random.key(req.seed), len(req.tokens))
+        return int(jax.random.categorical(key, jnp.asarray(scaled)))
+
+    # ------------------------------------------------------------ accounting
+
+    def _emit_request_record(self, req: GenRequest) -> None:
+        reg = self._registry
+        n = len(req.tokens)
+        queue_wait = (
+            req.admit_t - req.submit_t if req.admit_t is not None else None
+        )
+        ttft = (
+            req.first_token_t - req.submit_t
+            if req.first_token_t is not None
+            else None
+        )
+        decode_s = (
+            req.finish_t - req.first_token_t
+            if req.finish_t is not None and req.first_token_t is not None
+            else None
+        )
+        tpot = decode_s / (n - 1) if decode_s is not None and n > 1 else None
+        reg.emit({
+            "record": "serve_request",
+            "id": req.id,
+            "status": req.status,
+            "finish_reason": req.finish_reason,
+            "prompt_len": req.prompt_len,
+            "bucket": req.bucket,
+            "new_tokens": n,
+            "queue_wait_s": queue_wait,
+            "ttft_s": ttft,
+            "tpot_s": tpot,
+            "total_s": (
+                req.finish_t - req.submit_t
+                if req.finish_t is not None
+                else None
+            ),
+        })
+
+    def _finish(self, req: GenRequest, status: str, reason: str) -> None:
+        req.status = status
+        req.finish_reason = reason
+        req.finish_t = time.monotonic()
+        self.finished += 1
+        self._registry.inc(f"serve/finished_{status}")
+        self._emit_request_record(req)
+        cb = req.on_finish
+        if cb is not None:
+            try:
+                cb(req)
+            except Exception:  # pragma: no cover - user callback
+                logger.exception("on_finish callback failed for %s", req.id)
+        req.done.set()
+
+    def _emit_token(self, req: GenRequest, token: int) -> None:
+        now = time.monotonic()
+        if req.first_token_t is None:
+            req.first_token_t = now
+        req.tokens.append(int(token))
+        self._registry.inc("serve/tokens")
+        cb = req.stream
+        if cb is not None:
+            try:
+                cb(req, int(token))
+            except Exception:  # pragma: no cover - user callback
+                logger.exception("stream callback failed for %s", req.id)
+
+    # ----------------------------------------------------------------- slots
+
+    def slot_occupancy(self) -> float:
+        n = sum(1 for s in self._slots if s is not None)
+        return n / len(self._slots)
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self, req: GenRequest, slot: int) -> None:
+        """Prefill ``req`` into ``slot`` and sample its first token."""
+        req.status = "running"
+        req.admit_t = time.monotonic()
+        self.admitted += 1
+        self._registry.inc("serve/admitted")
+        bucket = req.bucket
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : req.prompt_len] = req.prompt_ids
+        with watchdog_guard("serve_prefill"):
+            last, self._cache = self._prefill_fn(bucket)(
+                self._params,
+                self._cache,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded),
+                jnp.asarray(req.prompt_len, jnp.int32),
+            )
+            logits = np.asarray(last)
+        token = self._sample(req, logits)
+        self._emit_token(req, token)
+        if self._is_terminal(req, token):
+            return
+        self._slots[slot] = _Slot(request=req, pending_token=token)
+
+    def _is_terminal(self, req: GenRequest, token: int) -> bool:
+        """Finish ``req`` if ``token`` completed it; True when finished."""
+        if req.eot_id is not None and token == req.eot_id:
+            self._finish(req, "done", "eot")
+            return True
+        if len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "done", "length")
+            return True
+        return False
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self) -> bool:
+        """One engine iteration: expire, admit, decode one token for every
+        active slot. Returns True when any work happened (the serve loop
+        idles on the queue condition otherwise)."""
+        t0 = time.monotonic()
+        worked = False
+
+        for req in self._queue.expire_overdue():
+            self._registry.inc("serve/expired")
+            self._finish(req, "expired", "deadline")
+            worked = True
+
+        # running-slot deadlines: stop spending decode on an abandoned answer
+        now = time.monotonic()
+        for i, s in enumerate(self._slots):
+            if s is not None and s.request.overdue(now):
+                self._slots[i] = None
+                self._registry.inc("serve/expired")
+                self._finish(s.request, "expired", "deadline")
+                worked = True
+
+        # admissions: fill free slots in scheduler order
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self._queue.pop_ready()
+            if req is None:
+                break
+            self._admit(req, slot)
+            worked = True
+
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if active:
+            S = self.config.num_slots
+            tokens = np.zeros((S,), np.int32)
+            mask = np.zeros((S,), bool)
+            for i in active:
+                tokens[i] = self._slots[i].pending_token
+                mask[i] = True
+            with watchdog_guard("serve_decode"):
+                logits, self._cache = self._decode_step_fn()(
+                    self._params,
+                    self._cache,
+                    jnp.asarray(tokens),
+                    jnp.asarray(mask),
+                )
+                self._last_logits = np.asarray(logits)
+            for i in active:
+                s = self._slots[i]
+                s.steps_done += 1
+                token = self._sample(s.request, self._last_logits[i])
+                self._emit_token(s.request, token)
+                if self._is_terminal(s.request, token):
+                    self._slots[i] = None       # evict: slot free for reuse
+                else:
+                    s.pending_token = token
+            worked = True
+
+        self.ticks += 1
+        self._registry.gauge("serve/queue_depth", self._queue.depth())
+        self._registry.gauge("serve/slot_occupancy", self.slot_occupancy())
+        if worked:
+            self._registry.observe("serve/tick", time.monotonic() - t0)
+            # deterministic serving-time stretch (PDT_TPU_FAULT=slow_host:Nx)
+            # — the chaos drill for deadline expiry and backpressure
+            from pytorch_distributed_training_tpu.faults.inject import get_plan
+
+            get_plan().slow_host_delay(time.monotonic() - t0)
+        return worked
+
+    # -------------------------------------------------------------- shutdown
+
+    def has_work(self) -> bool:
+        return any(s is not None for s in self._slots) or bool(
+            self._queue.depth()
+        )
+
+    def cancel_all(self) -> None:
+        """Terminate every in-flight and queued request (non-drain shutdown);
+        partial outputs stay on the request."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._slots[i] = None
+                self._registry.inc("serve/cancelled")
+                self._finish(s.request, "cancelled", "cancelled")
+        for req in self._queue.drain_pending():
+            self._registry.inc("serve/cancelled")
+            self._finish(req, "cancelled", "cancelled")
+
+    def stats(self) -> dict:
+        return {
+            "ticks": self.ticks,
+            "admitted": self.admitted,
+            "finished": self.finished,
+            "queue_depth": self._queue.depth(),
+            "slot_occupancy": self.slot_occupancy(),
+            "num_slots": self.config.num_slots,
+            "prompt_buckets": list(self.config.prompt_buckets),
+            "compiled_prefill_buckets": sorted(self._prefill_fns),
+        }

@@ -1,0 +1,364 @@
+"""Threaded serving front-end over the decode engine.
+
+``InferenceServer`` owns the request queue, the engine and the serve-loop
+thread (the engine is single-threaded by contract; every front-end thread
+only touches the queue). Two transports ship with it:
+
+- ``serve_stdio``: JSONL in / JSONL out. One request per input line
+  (``{"prompt": ..., "max_new_tokens": ..., ...}``); responses stream
+  back as ``token`` events followed by one ``done`` event per request,
+  interleaved across in-flight requests (that interleaving IS continuous
+  batching made visible).
+- ``make_http_server``: a localhost ``ThreadingHTTPServer``. ``POST
+  /generate`` streams the same JSONL event lines over a close-delimited
+  HTTP/1.0 response; queue-full maps to 429 (backpressure is an answer,
+  not a hang). ``GET /healthz`` and ``GET /stats`` expose liveness and
+  queue-depth/slot-occupancy for load balancers and dashboards.
+
+Shutdown: ``close(drain=True)`` stops admissions and runs the engine until
+in-flight work completes; ``close(drain=False)`` cancels everything
+in-flight — either way every waiter's ``done`` event fires (clean shutdown
+with in-flight requests is a tested contract, not best-effort).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_training_tpu.serve.engine import (
+    DecodeEngine,
+    EngineConfig,
+)
+from pytorch_distributed_training_tpu.serve.queue import (
+    BackpressureError,
+    GenRequest,
+    RequestQueue,
+)
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_IDLE_WAIT_S = 0.02
+
+
+class InferenceServer:
+    """Queue + engine + serve-loop thread, one object."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        config: EngineConfig,
+        *,
+        queue_depth: int = 16,
+        default_deadline_s: Optional[float] = None,
+        registry=None,
+    ):
+        self.queue = RequestQueue(
+            max_depth=queue_depth,
+            prompt_buckets=config.prompt_buckets,
+            max_new_tokens=config.max_new_tokens,
+        )
+        self.engine = DecodeEngine(
+            model, params, config, self.queue, registry=registry
+        )
+        self.default_deadline_s = default_deadline_s
+        self._ids = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._draining = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "InferenceServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="pdt-serve-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            if self._stop.is_set():
+                if not (self._draining and self.engine.has_work()):
+                    return
+            worked = self.engine.tick()
+            if not worked and not self._stop.is_set():
+                self.queue.wait_for_work(_IDLE_WAIT_S)
+
+    def close(self, *, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop serving. ``drain=True`` finishes in-flight and queued work
+        first; ``drain=False`` cancels it. Idempotent."""
+        self.queue.close()
+        self._draining = drain
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():  # pragma: no cover - watchdog's job
+                logger.error("serve loop failed to stop within %.1fs", timeout)
+            self._thread = None
+        if not drain:
+            self.engine.cancel_all()
+
+    # ------------------------------------------------------------ submission
+
+    def submit(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        eot_id: Optional[int] = None,
+        seed: int = 0,
+        deadline_s: Optional[float] = None,
+        stream=None,
+        on_finish=None,
+        request_id: Optional[str] = None,
+    ) -> GenRequest:
+        """Enqueue one request (any thread). Raises ``BackpressureError``
+        when the queue is full; the request's ``done`` event fires at every
+        terminal state."""
+        req = GenRequest(
+            id=request_id or f"r{next(self._ids)}",
+            prompt_ids=np.asarray(prompt_ids, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            eot_id=eot_id,
+            seed=seed,
+            deadline_s=(
+                deadline_s if deadline_s is not None else self.default_deadline_s
+            ),
+            stream=stream,
+            on_finish=on_finish,
+        )
+        return self.queue.submit(req)
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+
+# ------------------------------------------------------------------- stdio
+
+
+def _decode_text(tokenizer, tokens, eot_id) -> str:
+    ids = list(tokens)
+    if eot_id is not None and ids and ids[-1] == eot_id:
+        ids = ids[:-1]
+    return tokenizer.decode(ids)
+
+
+def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> int:
+    """JSONL request/response loop until EOF; returns requests served.
+
+    Input lines: ``{"prompt": str, "max_new_tokens"?: int,
+    "temperature"?: float, "top_k"?: int, "deadline_s"?: float,
+    "id"?: str}``. Output events (one JSON per line, interleaved across
+    requests): ``{"id", "event": "token", "token_id", "text"}``,
+    ``{"id", "event": "done", "status", "finish_reason", "text",
+    "new_tokens", "ttft_s"}`` and ``{"id", "event": "error", "error"}``.
+    """
+    wlock = threading.Lock()
+    eot_id = getattr(tokenizer, "eot_id", None)
+
+    def write(obj: dict) -> None:
+        with wlock:
+            out_stream.write(json.dumps(obj) + "\n")
+            out_stream.flush()
+
+    def on_token(req: GenRequest, token: int) -> None:
+        if eot_id is not None and token == eot_id:
+            return
+        write({
+            "id": req.id,
+            "event": "token",
+            "token_id": token,
+            "text": tokenizer.decode([token]),
+        })
+
+    def on_finish(req: GenRequest) -> None:
+        write({
+            "id": req.id,
+            "event": "done",
+            "status": req.status,
+            "finish_reason": req.finish_reason,
+            "text": _decode_text(tokenizer, req.tokens, eot_id),
+            "new_tokens": len(req.tokens),
+            "ttft_s": (
+                req.first_token_t - req.submit_t
+                if req.first_token_t is not None
+                else None
+            ),
+        })
+
+    pending: list[GenRequest] = []
+    served = 0
+    for line in in_stream:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+            prompt = msg["prompt"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            write({"event": "error", "error": f"bad request line: {e}"})
+            continue
+        ids = tokenizer.text_ids(prompt)
+        if not ids:
+            write({"id": msg.get("id"), "event": "error",
+                   "error": "empty prompt after tokenization"})
+            continue
+        try:
+            req = server.submit(
+                np.asarray(ids, np.int32),
+                max_new_tokens=int(
+                    msg.get("max_new_tokens",
+                            server.queue.max_new_tokens)
+                ),
+                temperature=float(msg.get("temperature", 0.0)),
+                top_k=int(msg.get("top_k", 0)),
+                eot_id=eot_id,
+                seed=int(msg.get("seed", 0)),
+                deadline_s=msg.get("deadline_s"),
+                stream=on_token,
+                on_finish=on_finish,
+                request_id=msg.get("id"),
+            )
+        except (BackpressureError, ValueError, RuntimeError) as e:
+            write({"id": msg.get("id"), "event": "error",
+                   "error": f"{type(e).__name__}: {e}"})
+            continue
+        pending.append(req)
+        served += 1
+    for req in pending:
+        req.done.wait()
+    return served
+
+
+# -------------------------------------------------------------------- http
+
+
+def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
+                     port: int = 0):
+    """A localhost ``ThreadingHTTPServer`` bound to ``(host, port)`` (port 0
+    picks a free one; read it back from ``.server_address``). The caller
+    runs ``serve_forever`` (blocking) or a thread around it."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    eot_id = getattr(tokenizer, "eot_id", None)
+
+    class Handler(BaseHTTPRequestHandler):
+        # close-delimited streaming bodies (no chunked framing needed)
+        protocol_version = "HTTP/1.0"
+
+        def log_message(self, fmt, *args):  # route through framework logging
+            logger.debug("http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict) -> None:
+            body = (json.dumps(obj) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"ok": True})
+            elif self.path == "/stats":
+                self._json(200, server.stats())
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                msg = json.loads(self.rfile.read(n) or b"{}")
+                prompt = msg["prompt"]
+            except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            ids = tokenizer.text_ids(prompt)
+            if not ids:
+                self._json(400, {"error": "empty prompt after tokenization"})
+                return
+
+            import queue as _q
+
+            events: _q.Queue = _q.Queue()
+
+            def on_token(req, token):
+                if eot_id is not None and token == eot_id:
+                    return
+                events.put({
+                    "event": "token",
+                    "token_id": token,
+                    "text": tokenizer.decode([token]),
+                })
+
+            def on_finish(req):
+                events.put({
+                    "event": "done",
+                    "status": req.status,
+                    "finish_reason": req.finish_reason,
+                    "text": _decode_text(tokenizer, req.tokens, eot_id),
+                    "new_tokens": len(req.tokens),
+                })
+                events.put(None)
+
+            try:
+                server.submit(
+                    np.asarray(ids, np.int32),
+                    max_new_tokens=int(
+                        msg.get("max_new_tokens",
+                                server.queue.max_new_tokens)
+                    ),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=int(msg.get("top_k", 0)),
+                    eot_id=eot_id,
+                    seed=int(msg.get("seed", 0)),
+                    deadline_s=msg.get("deadline_s"),
+                    stream=on_token,
+                    on_finish=on_finish,
+                    request_id=msg.get("id"),
+                )
+            except BackpressureError as e:
+                self._json(429, {"error": str(e)})
+                return
+            except (ValueError, RuntimeError) as e:
+                self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.end_headers()
+            while True:
+                ev = events.get()
+                if ev is None:
+                    break
+                self.wfile.write((json.dumps(ev) + "\n").encode())
+                self.wfile.flush()
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def wait_until(predicate, timeout: float, poll_s: float = 0.005) -> bool:
+    """Poll ``predicate`` until true or ``timeout``; serving tests' one
+    shared clock helper (kept here so tests and bench don't re-invent it)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return bool(predicate())

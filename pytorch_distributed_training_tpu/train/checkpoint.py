@@ -449,21 +449,34 @@ def restore_params(directory: str, *, params_like=None, step: Optional[int] = No
                 sh = getattr(x, "sharding", None)
                 if isinstance(sh, jax.sharding.Sharding):
                     return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
-                return ocp.utils.to_shape_dtype_struct(x)
+                # build the abstract leaf directly (older orbax's
+                # to_shape_dtype_struct chokes on sharding-less structs)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
             abstract = {"params": jax.tree.map(_sds, params_like)}
             restore_args = ocp.checkpoint_utils.construct_restore_args(
                 abstract
             )
-            restored = mngr.restore(
-                step,
-                args=ocp.args.PyTreeRestore(
+            try:
+                args = ocp.args.PyTreeRestore(
                     item=abstract, restore_args=restore_args,
                     partial_restore=True,
-                ),
-            )
+                )
+            except TypeError:
+                # older orbax (no partial_restore kwarg): an empty
+                # transforms dict is its partial-restore spelling — only
+                # the keys present in ``item`` are read
+                args = ocp.args.PyTreeRestore(
+                    item=abstract, restore_args=restore_args, transforms={},
+                )
+            restored = mngr.restore(step, args=args)
         else:
-            restored = mngr.restore(step)
+            try:
+                restored = mngr.restore(step)
+            except KeyError:
+                # older orbax can't infer the handler for a bare restore;
+                # name the PyTree handler explicitly
+                restored = mngr.restore(step, args=ocp.args.PyTreeRestore())
     log0(f"params restored: {directory}/{step}")
     return dict(restored)["params"]
 
@@ -498,8 +511,11 @@ def saved_params_scanned(directory: str, *, step: Optional[int] = None) -> bool:
     finally:
         ckptr.close()
     # StepMetadata.item_metadata.tree is the saved pytree structure with
-    # ArrayMetadata leaves (no tensor reads)
+    # ArrayMetadata leaves (no tensor reads); older orbax returns the tree
+    # itself as a plain dict
     tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    if tree is None and isinstance(meta, dict):
+        tree = meta
     if not isinstance(tree, dict) or "params" not in tree:
         raise ValueError(f"unrecognized checkpoint metadata under {directory}")
     return has_scanned_trunk(tree["params"])

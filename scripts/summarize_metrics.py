@@ -1,9 +1,16 @@
-"""Fold a telemetry JSONL stream into a per-epoch table.
+"""Fold a telemetry JSONL stream into a per-epoch (or serving) table.
 
 Reads the stream written by ``--metrics-dir`` (telemetry/sink.py) and prints
 one row per epoch: throughput (samples/sec/chip), where the step time went
 (data-wait %), and which host was slowest — the questions every perf PR has
 so far answered by hand-assembling BENCH_*/HISTORY_* artifacts.
+
+Serving streams (cli/serve_lm.py ``--metrics-dir``) get their own table:
+when ``serve_request`` records are present the summary carries a ``serve``
+section — per-bucket rows with request counts and p50/p95/p99 over TTFT
+(submit -> first token), TPOT (per-token decode latency) and total request
+latency, plus aggregate tokens/sec, queue-wait percentiles and
+expired/cancelled counts.
 
     python scripts/summarize_metrics.py /path/to/metrics_dir
     python scripts/summarize_metrics.py /path/to/metrics.jsonl --json
@@ -101,6 +108,73 @@ def summarize(records: list[dict]) -> dict:
         "compile": compile_summary,
         "checkpoint_saves": len(saves),
         "restarts": len(restarts),
+        "serve": summarize_serve(records),
+    }
+
+
+def _pcts(values: list) -> dict | None:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    import math
+
+    vals = sorted(vals)
+
+    def pct(p: float) -> float:
+        # nearest-rank on the sorted sample — honest for the small request
+        # counts a test/bench stream holds
+        return vals[min(len(vals) - 1, math.ceil(p / 100 * len(vals)) - 1)]
+
+    return {
+        "count": len(vals),
+        "mean": sum(vals) / len(vals),
+        "p50": pct(50),
+        "p95": pct(95),
+        "p99": pct(99),
+    }
+
+
+def summarize_serve(records: list[dict]) -> dict | None:
+    """Fold ``serve_request`` records into per-bucket latency percentiles
+    plus aggregate serving stats; None when the stream holds none."""
+    reqs = [r for r in records if r.get("record") == "serve_request"]
+    if not reqs:
+        return None
+    done = [r for r in reqs if r.get("status") == "done"]
+    by_bucket: dict[int, list[dict]] = {}
+    for r in done:
+        by_bucket.setdefault(int(r.get("bucket", 0)), []).append(r)
+    buckets = []
+    for bucket in sorted(by_bucket):
+        rs = by_bucket[bucket]
+        buckets.append({
+            "bucket": bucket,
+            "requests": len(rs),
+            "new_tokens": sum(r.get("new_tokens", 0) for r in rs),
+            "ttft_s": _pcts([r.get("ttft_s") for r in rs]),
+            "tpot_s": _pcts([r.get("tpot_s") for r in rs]),
+            "total_s": _pcts([r.get("total_s") for r in rs]),
+        })
+    tokens = sum(r.get("new_tokens", 0) for r in done)
+    # aggregate tokens/sec over the stream's request span (ts is stamped at
+    # finish; subtract the first request's own latency to recover its start)
+    span = None
+    if done:
+        ts = [r.get("ts") for r in done if r.get("ts") is not None]
+        if ts:
+            first = min(ts) - (done[0].get("total_s") or 0.0)
+            span = max(max(ts) - first, 1e-9)
+    return {
+        "requests": len(reqs),
+        "done": len(done),
+        "expired": sum(1 for r in reqs if r.get("status") == "expired"),
+        "cancelled": sum(1 for r in reqs if r.get("status") == "cancelled"),
+        "tokens": tokens,
+        "tokens_per_s": tokens / span if span else None,
+        "queue_wait_s": _pcts([r.get("queue_wait_s") for r in reqs]),
+        "ttft_s": _pcts([r.get("ttft_s") for r in done]),
+        "tpot_s": _pcts([r.get("tpot_s") for r in done]),
+        "buckets": buckets,
     }
 
 
@@ -110,6 +184,41 @@ def _fmt(v, spec=".4g") -> str:
     if isinstance(v, float):
         return format(v, spec)
     return str(v)
+
+
+def render_serve_table(serve: dict) -> str:
+    """Per-bucket serving rows + an aggregate footer."""
+    def ms(block: dict | None, key: str):
+        return block[key] * 1e3 if block and block.get(key) is not None else None
+
+    cols = ["bucket", "reqs", "tokens", "ttft p50 ms", "ttft p95 ms",
+            "ttft p99 ms", "tpot p50 ms", "tpot p95 ms", "total p95 ms"]
+    rows = []
+    for b in serve["buckets"]:
+        rows.append([
+            _fmt(b["bucket"]), _fmt(b["requests"]), _fmt(b["new_tokens"]),
+            _fmt(ms(b["ttft_s"], "p50")), _fmt(ms(b["ttft_s"], "p95")),
+            _fmt(ms(b["ttft_s"], "p99")), _fmt(ms(b["tpot_s"], "p50")),
+            _fmt(ms(b["tpot_s"], "p95")), _fmt(ms(b["total_s"], "p95")),
+        ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "serving:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    qw = serve.get("queue_wait_s") or {}
+    lines.append(
+        f"requests={serve['requests']} done={serve['done']} "
+        f"expired={serve['expired']} cancelled={serve['cancelled']} "
+        f"tokens/s={_fmt(serve.get('tokens_per_s'))} "
+        f"queue-wait p95={_fmt(ms(qw, 'p95') if qw else None)}ms"
+    )
+    return "\n".join(lines)
 
 
 def render_table(summary: dict) -> str:
@@ -151,6 +260,12 @@ def render_table(summary: dict) -> str:
             f"eval {_fmt(comp.get('eval_compile_s'))}s, "
             f"cache={'hit' if hit else 'miss' if hit is not None else 'off'})"
         )
+    serve = summary.get("serve")
+    if serve:
+        if summary["epochs"]:
+            lines.append(render_serve_table(serve))
+        else:  # pure serving stream: the serve table IS the output
+            lines = [render_serve_table(serve)]
     return "\n".join(lines)
 
 

@@ -1,0 +1,52 @@
+"""Serving performance acceptance (opt-in: ``-m perf``).
+
+Drives ``bench.py --serve`` in a subprocess: a closed-loop load generator
+over the continuous-batching engine vs sequential one-shot ``generate()``
+calls on the SAME prompt mix, both compile-warmed. Asserts the PR's
+acceptance criterion — with >= 2 decode slots the engine sustains strictly
+higher aggregate tokens/sec — plus the artifact contract (latency
+percentiles present, request accounting adds up). Timing-based, so it
+stays out of tier-1 (conftest auto-skips without ``-m perf``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.perf, pytest.mark.serve]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serve_bench_beats_sequential(tmp_path):
+    out = tmp_path / "BENCH_serve.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--serve", "--serve-requests", "16", "--serve-slots", "4",
+            "--serve-concurrency", "6", "--serve-out", str(out),
+        ],
+        capture_output=True, text=True, timeout=1200, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(out.read_text())
+
+    eng, seq = result["engine"], result["sequential"]
+    # the acceptance criterion: continuous batching over >= 2 slots beats
+    # sequential one-shot generation on aggregate tokens/sec
+    assert eng["slots"] >= 2
+    assert eng["tokens_per_s"] > seq["tokens_per_s"], result
+    assert result["speedup"] > 1.0
+
+    # same workload on both sides, every request served
+    assert eng["tokens"] == seq["tokens"]
+    assert eng["requests"] == 16
+
+    # the artifact carries real latency percentiles
+    for block in ("ttft_s", "tpot_s", "queue_wait_s"):
+        stats = eng[block]
+        assert stats["count"] > 0
+        assert stats["p50"] <= stats["p95"] <= stats["p99"]
