@@ -268,8 +268,11 @@ class DecodeEngine:
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
         scaled = logits / req.temperature
-        if req.top_k > 0:
-            kth = np.sort(scaled)[-req.top_k]
+        # clamp to vocab size: top_k >= vocab means "no truncation", and an
+        # oversized client value must not be able to crash the serve loop
+        k = min(req.top_k, scaled.shape[-1])
+        if k > 0:
+            kth = np.sort(scaled)[-k]
             scaled = np.where(scaled < kth, np.finfo(np.float32).min, scaled)
         key = jax.random.fold_in(jax.random.key(req.seed), len(req.tokens))
         return int(jax.random.categorical(key, jnp.asarray(scaled)))

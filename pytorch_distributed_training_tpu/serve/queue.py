@@ -135,6 +135,12 @@ class RequestQueue:
                 f"max_new_tokens {request.max_new_tokens} outside "
                 f"[1, {self.max_new_tokens}]"
             )
+        if request.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {request.top_k}")
+        if not np.isfinite(request.temperature):
+            raise ValueError(
+                f"temperature must be finite, got {request.temperature}"
+            )
         bucket = self.bucket_for(request.prompt_len)
         with self._lock:
             if self._closed:

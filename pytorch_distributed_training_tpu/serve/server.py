@@ -86,13 +86,27 @@ class InferenceServer:
         return self
 
     def _loop(self) -> None:
-        while True:
-            if self._stop.is_set():
-                if not (self._draining and self.engine.has_work()):
-                    return
-            worked = self.engine.tick()
-            if not worked and not self._stop.is_set():
-                self.queue.wait_for_work(_IDLE_WAIT_S)
+        try:
+            while True:
+                if self._stop.is_set():
+                    if not (self._draining and self.engine.has_work()):
+                        return
+                worked = self.engine.tick()
+                if not worked and not self._stop.is_set():
+                    self.queue.wait_for_work(_IDLE_WAIT_S)
+        except Exception:
+            # A tick must never die silently: waiters block on request
+            # ``done`` events with no timeout, so a dead loop would wedge
+            # every in-flight and queued request. Fail them all instead
+            # (rejected/cancelled, never hung) and refuse new submissions.
+            logger.exception(
+                "serve loop died; cancelling all in-flight requests"
+            )
+            self.queue.close()
+            try:
+                self.engine.cancel_all()
+            except Exception:  # pragma: no cover - best-effort cleanup
+                logger.exception("cancel_all after serve-loop failure failed")
 
     def close(self, *, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop serving. ``drain=True`` finishes in-flight and queued work
@@ -100,11 +114,18 @@ class InferenceServer:
         self.queue.close()
         self._draining = drain
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():  # pragma: no cover - watchdog's job
-                logger.error("serve loop failed to stop within %.1fs", timeout)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():  # pragma: no cover - watchdog's job
+                # The engine is single-threaded by contract and the loop
+                # thread still owns it — mutating slots/queue from here
+                # would race it. Leave state to the wedged thread.
+                logger.error(
+                    "serve loop failed to stop within %.1fs; "
+                    "skipping cancel_all", timeout,
+                )
+                return
         if not drain:
             self.engine.cancel_all()
 
@@ -209,6 +230,10 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
         try:
             msg = json.loads(line)
             prompt = msg["prompt"]
+            if not isinstance(prompt, str):
+                raise TypeError(
+                    f"prompt must be a string, got {type(prompt).__name__}"
+                )
         except (json.JSONDecodeError, KeyError, TypeError) as e:
             write({"event": "error", "error": f"bad request line: {e}"})
             continue
@@ -287,6 +312,10 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 n = int(self.headers.get("Content-Length", "0"))
                 msg = json.loads(self.rfile.read(n) or b"{}")
                 prompt = msg["prompt"]
+                if not isinstance(prompt, str):
+                    raise TypeError(
+                        f"prompt must be a string, got {type(prompt).__name__}"
+                    )
             except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return

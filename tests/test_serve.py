@@ -221,6 +221,56 @@ def test_backpressure_rejects_never_hangs(lm):
     server.close(drain=False)
 
 
+def test_oversized_top_k_is_safe(lm):
+    """A client top_k larger than the vocab must not crash the serve loop:
+    it degrades to full-vocab sampling. Negative top_k is rejected O(1) at
+    submit, same path as the other out-of-contract params."""
+    model, params = lm
+    server = InferenceServer(
+        model, params,
+        EngineConfig(num_slots=1, prompt_buckets=(8,), max_new_tokens=4),
+        queue_depth=2,
+    ).start()
+    try:
+        req = server.submit(
+            _prompts(model, [4], seed=13)[0], max_new_tokens=4,
+            temperature=1.0, top_k=10 * model.config.vocab_size,
+        )
+        assert wait_until(req.done.is_set, timeout=120)
+        with pytest.raises(ValueError):
+            server.submit(_prompts(model, [4], seed=13)[0],
+                          max_new_tokens=4, top_k=-1)
+    finally:
+        server.close()
+    assert req.status == "done"
+    assert all(0 <= t < model.config.vocab_size for t in req.tokens)
+
+
+def test_serve_loop_failure_fails_requests_not_hangs(lm):
+    """If a tick raises, the loop must not die silently: every in-flight
+    and queued request's waiter completes (cancelled) and new submissions
+    are refused — the 'rejected, never hung' contract under engine failure."""
+    model, params = lm
+    server = InferenceServer(
+        model, params,
+        EngineConfig(num_slots=1, prompt_buckets=(8,), max_new_tokens=4),
+        queue_depth=4,
+    )
+    prompts = _prompts(model, [4, 4], seed=12)
+    reqs = [server.submit(p, max_new_tokens=4) for p in prompts]
+
+    def boom():
+        raise RuntimeError("injected tick failure")
+
+    server.engine.tick = boom
+    server.start()
+    assert wait_until(lambda: all(r.done.is_set() for r in reqs), timeout=30)
+    assert all(r.status == "cancelled" for r in reqs)
+    with pytest.raises(RuntimeError):
+        server.submit(prompts[0], max_new_tokens=2)
+    server.close(drain=False)
+
+
 def test_queued_deadline_expires_unserved(lm):
     """A queued request past its deadline is expired by the next tick —
     no prefill is spent on it and its waiter completes."""
@@ -402,6 +452,7 @@ def test_serve_stdio_end_to_end(lm, tmp_path):
         json.dumps({"prompt": "the quick brown fox", "max_new_tokens": 3,
                     "id": "b"}),
         "not json",
+        json.dumps({"prompt": 123, "id": "d"}),  # non-string prompt
         json.dumps({"prompt": "bye", "max_new_tokens": 3, "id": "c"}),
     ]) + "\n")
     out = io.StringIO()
@@ -417,7 +468,8 @@ def test_serve_stdio_end_to_end(lm, tmp_path):
     assert all(d["status"] == "done" and d["new_tokens"] == 3
                for d in done.values())
     assert sum(1 for e in events if e.get("event") == "token") == 9
-    assert any(e.get("event") == "error" for e in events)  # the bad line
+    # the non-JSON line and the non-string prompt each yield an error event
+    assert sum(1 for e in events if e.get("event") == "error") == 2
     assert stats["admitted"] == 3 and stats["finished"] == 3
 
     # the JSONL stream folds into the serving percentile table
@@ -468,6 +520,12 @@ def test_http_front_end(lm):
         c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
         c.request("POST", "/generate", body=json.dumps({"prompt": "hi"}))
         assert c.getresponse().status == 429
+        c.close()
+
+        # a non-string prompt is a 400, not a dropped connection
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request("POST", "/generate", body=json.dumps({"prompt": 123}))
+        assert c.getresponse().status == 400
         c.close()
 
         # drain by hand, then start the real loop for a streamed generation
