@@ -1,0 +1,55 @@
+"""Correctness tooling: static JAX linter + runtime guard layer.
+
+Two halves, one goal — make the classic JAX perf/correctness regressions
+(silent per-shape recompiles, implicit host<->device transfers in hot
+loops, dropped buffer donations, tracer leaks, reused PRNG keys)
+impossible to ship rather than merely hard to write:
+
+- **Static linter** (``lint.py`` + ``rules/``): an AST pass over the
+  package with JAX-specific rules. Driven by ``scripts/lint.py``; every
+  finding is either fixed or explicitly waived in ``waivers.toml`` with a
+  one-line reason, so ``scripts/lint.py --check`` gates a clean tree.
+- **Runtime guards** (``guards.py``): a recompile counter around jitted
+  entry points (retracing after warm-up is a violation), a
+  ``jax.transfer_guard``-based implicit-transfer detector armed around
+  the Trainer step and the serve tick, and post-lower donation/sharding
+  audits. Violations emit ``recompile`` / ``implicit_transfer`` /
+  ``donation_audit`` / ``sharding_audit`` telemetry records (surfaced by
+  ``scripts/summarize_metrics.py``) and, in strict mode, raise.
+"""
+
+from pytorch_distributed_training_tpu.analysis.guards import (
+    GuardSet,
+    GuardViolation,
+    RecompileError,
+    TransferGuardError,
+    donation_audit,
+    guard_mode_from_env,
+    sharding_audit,
+)
+from pytorch_distributed_training_tpu.analysis.lint import (
+    Finding,
+    LintReport,
+    lint_paths,
+    lint_source,
+)
+from pytorch_distributed_training_tpu.analysis.waivers import (
+    Waiver,
+    load_waivers,
+)
+
+__all__ = [
+    "Finding",
+    "GuardSet",
+    "GuardViolation",
+    "LintReport",
+    "RecompileError",
+    "TransferGuardError",
+    "Waiver",
+    "donation_audit",
+    "guard_mode_from_env",
+    "lint_paths",
+    "lint_source",
+    "load_waivers",
+    "sharding_audit",
+]

@@ -1,0 +1,356 @@
+"""Runtime guard layer: recompiles, implicit transfers, donation, sharding.
+
+The static linter (``analysis/lint.py``) catches what's visible in
+source; this module catches what only shows up live:
+
+- **Recompile detector** — ``GuardSet.wrap_jit(name, fn)`` wraps a jitted
+  callable; after its warm-up compile, any further trace (jit cache
+  growth) is a violation: a ``recompile`` telemetry record + counter, and
+  a ``RecompileError`` in strict mode. AOT-``Compiled`` objects cannot
+  retrace and pass through trivially (but still get transfer arming).
+- **Implicit-transfer detector** — warm guarded calls run under
+  ``jax.transfer_guard``: ``"disallow"`` in strict mode (the classic bug
+  — an un-placed host array fed to a warm step forces a per-call H2D
+  copy — raises, is recorded as an ``implicit_transfer`` record, and
+  re-raises as ``TransferGuardError``); ``"log"`` in record mode.
+  ``GuardSet.transfer_scope(name)`` arms the same detector around
+  arbitrary host regions (the serve tick, custom loops).
+- **Donation audit** — ``donation_audit(name, lowered_or_compiled)``
+  parses the lowering/HLO text for input-output aliasing and emits a
+  ``donation_audit`` record; requesting donation that XLA dropped is a
+  violation (the input buffer stays live, doubling resident HBM).
+- **Sharding audit** — ``sharding_audit(params, mesh)`` flags
+  above-threshold leaves left fully replicated while the mesh has
+  non-trivial fsdp/model/stage axes (a sharding policy that silently
+  didn't apply), as a ``sharding_audit`` record.
+
+Modes (``PDT_TPU_GUARDS`` env or ``TrainConfig.guards`` / serve
+``--guards``): ``off`` — pass-through; ``record`` (default) — detect,
+count, emit telemetry, never raise; ``strict`` — record AND raise (what
+the tier-1 guard tests run under).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import re
+import threading
+from typing import Any, Optional
+
+import jax
+
+_MODES = ("off", "record", "strict")
+
+# ------------------------------------------------------- trace accounting
+#
+# Retrace detection rides jax.monitoring: every jaxpr trace fires a
+# '/jax/core/compile/jaxpr_trace_duration' event IN THE TRACING THREAD,
+# and a warm executable fires none. A thread-local counter scoped around
+# each guarded call is therefore an exact "did THIS call trace anything"
+# probe — immune to the C++ fast-path cache adding entries without
+# retracing (observed on this jax: cache_size can grow on a warm step),
+# to other threads compiling concurrently (prefetch placement, a second
+# engine), and to persistent-cache hits that skip the backend compile.
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_tls = threading.local()
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_duration(name: str, *args, **kwargs) -> None:
+    if name == _TRACE_EVENT:
+        _tls.traces = getattr(_tls, "traces", 0) + 1
+
+
+def _ensure_trace_listener() -> None:
+    global _listener_installed
+    with _listener_lock:
+        if not _listener_installed:
+            jax.monitoring.register_event_duration_secs_listener(_on_duration)
+            _listener_installed = True
+
+
+def _trace_count() -> int:
+    return getattr(_tls, "traces", 0)
+
+
+class GuardViolation(RuntimeError):
+    """A runtime correctness guard tripped (strict mode)."""
+
+
+class RecompileError(GuardViolation):
+    """A jitted entry point retraced after warm-up."""
+
+
+class TransferGuardError(GuardViolation):
+    """An implicit host<->device transfer happened in a guarded region."""
+
+
+def guard_mode_from_env(default: str = "record") -> str:
+    mode = os.environ.get("PDT_TPU_GUARDS", default)
+    if mode not in _MODES:
+        raise ValueError(
+            f"PDT_TPU_GUARDS must be one of {_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def _registry_or_default(registry):
+    if registry is not None:
+        return registry
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        get_registry,
+    )
+
+    return get_registry()
+
+
+class GuardedCall:
+    """Wrapper installed by ``GuardSet.wrap_jit`` around one jitted entry
+    point. Transparent to the call contract; adds per-call retrace
+    accounting and transfer-guard arming once warm. An AOT ``Compiled``
+    (no ``_cache_size`` trace cache) gets NO warm-up allowance — it can
+    never legally trace; a jit gets exactly one warm-up call."""
+
+    def __init__(self, name: str, fn, guards: "GuardSet"):
+        self.name = name
+        self.fn = fn
+        self.guards = guards
+        self._warm = not hasattr(fn, "_cache_size")
+        self.calls = 0
+        self.recompiles = 0
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def __call__(self, *args, **kwargs):
+        g = self.guards
+        if g.mode == "off":
+            return self.fn(*args, **kwargs)
+        self.calls += 1
+        warm = self._warm
+        ctx = g._transfer_context() if warm else contextlib.nullcontext()
+        traces_before = _trace_count()
+        try:
+            with ctx:
+                out = self.fn(*args, **kwargs)
+        except jax.errors.JaxRuntimeError as e:
+            if "Disallowed" in str(e) and "transfer" in str(e):
+                g._transfer_violation(self.name, e)
+            raise
+        traced = _trace_count() - traces_before
+        if not warm:
+            self._warm = True  # the one expected warm-up compile
+        elif traced:
+            self.recompiles += 1
+            g._recompile_violation(self, traced)
+        return out
+
+    def __getattr__(self, item):  # .lower/.trace/... pass through
+        return getattr(self.fn, item)
+
+
+@dataclasses.dataclass
+class GuardSet:
+    """One guard policy + its wrapped entry points + violation counters."""
+
+    mode: str = "record"
+    registry: Any = None
+    transfer: bool = True  # arm jax.transfer_guard around warm calls
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(
+                f"guards mode must be one of {_MODES}, got {self.mode!r}"
+            )
+        self.registry = _registry_or_default(self.registry)
+        self.wrapped: dict[str, GuardedCall] = {}
+        self.recompile_violations = 0
+        self.transfer_violations = 0
+        if self.mode != "off":
+            _ensure_trace_listener()
+
+    # ------------------------------------------------------------- wrapping
+
+    def wrap_jit(self, name: str, fn):
+        """Wrap a jitted (or AOT-compiled) callable; idempotent."""
+        if isinstance(fn, GuardedCall):
+            return fn
+        wrapped = GuardedCall(name, fn, self)
+        self.wrapped[name] = wrapped
+        return wrapped
+
+    # ------------------------------------------------------------ transfers
+
+    def _transfer_context(self):
+        if not self.transfer or self.mode == "off":
+            return contextlib.nullcontext()
+        return jax.transfer_guard("disallow" if self.mode == "strict" else "log")
+
+    @contextlib.contextmanager
+    def transfer_scope(self, name: str):
+        """Arm the implicit-transfer detector around a host code region
+        (e.g. one serve tick). Violations emit ``implicit_transfer`` and,
+        in strict mode, re-raise as ``TransferGuardError``."""
+        try:
+            with self._transfer_context():
+                yield
+        except jax.errors.JaxRuntimeError as e:
+            if "Disallowed" in str(e) and "transfer" in str(e):
+                self._transfer_violation(name, e)
+            raise
+
+    def _transfer_violation(self, name: str, exc: Exception) -> None:
+        self.transfer_violations += 1
+        self.registry.inc("guards/implicit_transfers")
+        self.registry.emit({
+            "record": "implicit_transfer",
+            "name": name,
+            "error": str(exc).split("\n")[0][:300],
+        })
+        raise TransferGuardError(
+            f"implicit transfer in guarded region {name!r}: "
+            f"{str(exc).splitlines()[0]}"
+        ) from exc
+
+    # ------------------------------------------------------------ recompiles
+
+    def _recompile_violation(self, call: GuardedCall, traced: int) -> None:
+        self.recompile_violations += 1
+        self.registry.inc("guards/recompiles")
+        self.registry.emit({
+            "record": "recompile",
+            "name": call.name,
+            "calls": call.calls,
+            "traces": traced,
+            "recompiles": call.recompiles,
+        })
+        if self.mode == "strict":
+            raise RecompileError(
+                f"jitted entry point {call.name!r} retraced after warm-up "
+                f"(call {call.calls} traced {traced} jaxpr(s)) — a shape/"
+                f"dtype/static-arg is varying per call"
+            )
+
+    @property
+    def violations(self) -> int:
+        return self.recompile_violations + self.transfer_violations
+
+
+# ---------------------------------------------------------------- donation
+
+# lowering text marks donated params with tf.aliasing_output; compiled HLO
+# carries an input_output_alias map with one (may|must)-alias entry each
+_ALIAS_PATTERNS = (
+    re.compile(r"tf\.aliasing_output"),
+    re.compile(r"(?:may|must)[-_]alias"),
+)
+
+
+def count_aliased_buffers(hlo_text: str) -> int:
+    """Donated-input count visible in a lowering / compiled-HLO dump."""
+    return max(len(p.findall(hlo_text)) for p in _ALIAS_PATTERNS)
+
+
+def donation_audit(
+    name: str,
+    stage,
+    *,
+    expected: bool = True,
+    registry=None,
+    mode: str = "record",
+) -> dict:
+    """Post-lower audit: did the donation requested at jit time survive to
+    the executable? ``stage`` is a ``Lowered`` or ``Compiled`` (anything
+    with ``as_text()``). Emits a ``donation_audit`` record; strict mode
+    raises when donation was expected but zero buffers alias."""
+    registry = _registry_or_default(registry)
+    try:
+        text = stage.as_text()
+    except Exception as e:  # pragma: no cover - backend without text dump
+        record = {
+            "record": "donation_audit", "name": name, "aliased": None,
+            "ok": None, "error": str(e)[:200],
+        }
+        registry.emit(record)
+        return record
+    aliased = count_aliased_buffers(text)
+    ok = (aliased > 0) if expected else True
+    record = {
+        "record": "donation_audit",
+        "name": name,
+        "aliased": aliased,
+        "expected": expected,
+        "ok": ok,
+    }
+    registry.emit(record)
+    if not ok:
+        registry.inc("guards/donation_dropped")
+        if mode == "strict":
+            raise GuardViolation(
+                f"donation audit {name!r}: donate_argnums was requested but "
+                f"no input aliases an output — the donated buffer stays "
+                f"live across every call"
+            )
+    return record
+
+
+# ---------------------------------------------------------------- sharding
+
+_SHARDED_AXES = ("fsdp", "model", "stage")
+
+
+def sharding_audit(
+    params,
+    mesh,
+    *,
+    min_bytes: int = 1 << 20,
+    registry=None,
+    mode: str = "record",
+    name: str = "params",
+) -> dict:
+    """Flag large leaves left fully replicated on a mesh whose fsdp/model/
+    stage axes say they should be sharded. Data-parallel-only meshes
+    (every non-data axis == 1) replicate by design and audit clean."""
+    registry = _registry_or_default(registry)
+    shard_capacity = 1
+    for ax in _SHARDED_AXES:
+        shard_capacity *= dict(mesh.shape).get(ax, 1)
+    flagged: list[dict] = []
+    if shard_capacity > 1:
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in flat:
+            nbytes = getattr(leaf, "nbytes", 0)
+            sharding = getattr(leaf, "sharding", None)
+            if nbytes < min_bytes or sharding is None:
+                continue
+            if sharding.is_fully_replicated:
+                flagged.append({
+                    "path": jax.tree_util.keystr(path),
+                    "bytes": int(nbytes),
+                })
+    record = {
+        "record": "sharding_audit",
+        "name": name,
+        "mesh_shape": dict(mesh.shape),
+        "min_bytes": min_bytes,
+        "flagged": flagged,
+        "replicated_bytes": sum(f["bytes"] for f in flagged),
+        "ok": not flagged,
+    }
+    registry.emit(record)
+    if flagged:
+        registry.inc("guards/replicated_large_params", len(flagged))
+        if mode == "strict":
+            worst = max(flagged, key=lambda f: f["bytes"])
+            raise GuardViolation(
+                f"sharding audit {name!r}: {len(flagged)} leaf/leaves >= "
+                f"{min_bytes}B fully replicated on a "
+                f"{dict(mesh.shape)} mesh (largest: {worst['path']} at "
+                f"{worst['bytes']}B) — the sharding policy did not apply"
+            )
+    return record

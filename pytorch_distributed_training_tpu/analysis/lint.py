@@ -1,0 +1,142 @@
+"""Lint driver: parse -> per-rule checks -> waiver fold -> report.
+
+``lint_paths`` walks files/directories, builds one ``ModuleContext`` per
+parseable Python file and runs every registered rule (``rules/``) over
+it. Waivers (``waivers.py``) split raw findings into *active* (must be
+fixed) and *waived* (documented-intentional); unused waivers are
+reported so dead suppressions rot out of the file.
+
+``scripts/lint.py`` is the CLI; ``summary_record`` shapes the result as
+a ``lint_summary`` telemetry record so lint health rides the same JSONL
+stream as runtime metrics (``scripts/summarize_metrics.py`` folds it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from pytorch_distributed_training_tpu.analysis.rules import ALL_RULES
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+)
+from pytorch_distributed_training_tpu.analysis.waivers import Waiver
+
+# repo root = parent of the package dir (analysis/ is one level in)
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_WAIVERS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "waivers.toml"
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", ".jax_cache"}
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]            # active (unwaived)
+    waived: list[tuple[Finding, Waiver]]
+    unused_waivers: list[Waiver]
+    files: int
+    errors: list[str]                  # unparseable files
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+    def by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def _rel(path: str) -> str:
+    path = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(path, REPO_ROOT)
+    except ValueError:  # different drive (windows)
+        return path
+    return path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules=ALL_RULES
+) -> list[Finding]:
+    """Lint one source string (rule unit tests drive this directly)."""
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path, source, tree)
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(
+    paths: list[str],
+    waivers: list[Waiver] | None = None,
+    rules=ALL_RULES,
+) -> LintReport:
+    waivers = list(waivers or [])
+    all_findings: list[Finding] = []
+    errors: list[str] = []
+    files = iter_python_files(paths)
+    for fpath in files:
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                source = f.read()
+            all_findings.extend(lint_source(source, _rel(fpath), rules))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{_rel(fpath)}: unparseable: {e}")
+
+    active: list[Finding] = []
+    waived: list[tuple[Finding, Waiver]] = []
+    used: set[int] = set()
+    for finding in all_findings:
+        for i, w in enumerate(waivers):
+            if w.matches(finding):
+                waived.append((finding, w))
+                used.add(i)
+                break
+        else:
+            active.append(finding)
+    unused = [w for i, w in enumerate(waivers) if i not in used]
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintReport(
+        findings=active, waived=waived, unused_waivers=unused,
+        files=len(files), errors=errors,
+    )
+
+
+def summary_record(report: LintReport) -> dict:
+    """Shape a report as the ``lint_summary`` telemetry record."""
+    return {
+        "record": "lint_summary",
+        "files": report.files,
+        "findings": len(report.findings),
+        "waived": len(report.waived),
+        "unused_waivers": len(report.unused_waivers),
+        "parse_errors": len(report.errors),
+        "by_rule": report.by_rule(),
+        "clean": report.clean,
+    }

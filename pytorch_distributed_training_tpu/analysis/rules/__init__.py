@@ -1,0 +1,41 @@
+"""Rule registry: every lint rule module, in reporting order.
+
+A rule module exposes ``RULE_ID`` (``host_transfer`` exposes two) and
+``check(ctx: ModuleContext) -> list[Finding]``. Adding a rule = adding a
+module here; the driver (``analysis/lint.py``) and ``scripts/lint.py``
+pick it up automatically.
+"""
+
+from pytorch_distributed_training_tpu.analysis.rules import (
+    donation,
+    host_transfer,
+    impure_call,
+    mutable_default,
+    prng_reuse,
+    traced_branch,
+)
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+)
+
+ALL_RULES = (
+    traced_branch,
+    impure_call,
+    host_transfer,
+    donation,
+    prng_reuse,
+    mutable_default,
+)
+
+RULE_IDS = tuple(
+    rid
+    for mod in ALL_RULES
+    for rid in (
+        (mod.RULE_ID, mod.LOOP_RULE_ID)
+        if hasattr(mod, "LOOP_RULE_ID")
+        else (mod.RULE_ID,)
+    )
+)
+
+__all__ = ["ALL_RULES", "RULE_IDS", "Finding", "ModuleContext"]

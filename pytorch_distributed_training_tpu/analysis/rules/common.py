@@ -1,0 +1,393 @@
+"""Shared AST machinery for the lint rules.
+
+One ``ModuleContext`` is built per file and handed to every rule: parsed
+tree, parent links, function qualnames, the import-alias map (``np`` ->
+``numpy``), the set of *traced* functions (bodies that execute under a
+``jax.jit``/``pjit``/``vmap``/``grad``/``scan`` trace), and a per-function
+taint analysis marking names derived from the traced function's own
+parameters — i.e. the names that hold tracers at trace time.
+
+All of it is deliberately heuristic: the linter's contract is "high-value
+findings with a waiver escape hatch", not soundness. Rules err toward
+missing exotic constructions over flagging idiomatic host code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, addressable by (rule, file, symbol) for waivers."""
+
+    rule: str
+    path: str  # repo-relative where possible
+    line: int
+    col: int
+    symbol: str  # enclosing function qualname ("" at module level)
+    message: str
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{sym} {self.message}"
+
+
+# Callables whose function-valued argument gets traced. Split by how the
+# function argument is found: jit-ish wrappers trace arg 0; scan/cond
+# style combinators also trace arg 0 (the body/carry fn).
+_TRACING_CALLABLES = {
+    "jax.jit",
+    "jit",
+    "pjit",
+    "jax.pmap",
+    "pmap",
+    "nn.jit",
+    "jax.vmap",
+    "vmap",
+    "jax.grad",
+    "grad",
+    "jax.value_and_grad",
+    "value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.map",
+    "jax.lax.while_loop",
+    "jax.lax.cond",
+    "jax.lax.fori_loop",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+
+# jit-ish names valid as decorators (bare or via functools.partial)
+_JIT_DECORATORS = {
+    "jax.jit",
+    "jit",
+    "pjit",
+    "jax.pmap",
+    "pmap",
+    "nn.jit",
+    "jax.checkpoint",
+    "jax.remat",
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` from the Attribute/Name chain; None if not a pure
+    dotted chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleContext:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.qualnames: dict[ast.AST, str] = {}
+        self.aliases: dict[str, str] = {}  # local name -> imported dotted name
+        self._functions: list[ast.FunctionDef | ast.AsyncFunctionDef] = []
+        self._build()
+        self.traced: set[ast.AST] = self._find_traced()
+
+    # -------------------------------------------------------------- building
+
+    def _build(self) -> None:
+        stack: list[tuple[ast.AST, str]] = [(self.tree, "")]
+        while stack:
+            node, prefix = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                qn = prefix
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    qn = f"{prefix}.{child.name}" if prefix else child.name
+                    self.qualnames[child] = qn
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._functions.append(child)
+                elif isinstance(child, ast.Import):
+                    for a in child.names:
+                        self.aliases[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0]
+                        )
+                elif isinstance(child, ast.ImportFrom) and child.module:
+                    for a in child.names:
+                        self.aliases[a.asname or a.name] = (
+                            f"{child.module}.{a.name}"
+                        )
+                stack.append((child, qn))
+
+    def functions(self) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+        return self._functions
+
+    def qualname_of(self, node: ast.AST) -> str:
+        """Qualname of the function/class enclosing ``node`` ("" if module
+        level)."""
+        cur = node
+        while cur is not None:
+            if cur in self.qualnames:
+                return self.qualnames[cur]
+            cur = self.parents.get(cur)
+        return ""
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> Optional[ast.FunctionDef | ast.AsyncFunctionDef]:
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name with the leading segment resolved through imports:
+        ``np.random.normal`` -> ``numpy.random.normal``; ``jit`` (from
+        ``from jax import jit``) -> ``jax.jit``."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        target = self.aliases.get(head)
+        if target is None:
+            return name
+        return f"{target}.{rest}" if rest else target
+
+    # ------------------------------------------------------- traced scoping
+
+    def _is_jit_decorator(self, dec: ast.AST) -> bool:
+        resolved = self.resolve(dec)
+        if resolved in _JIT_DECORATORS:
+            return True
+        if isinstance(dec, ast.Call):
+            fn = self.resolve(dec.func)
+            if fn in _JIT_DECORATORS:
+                return True  # @jax.jit(static_argnums=...)
+            if fn in ("functools.partial", "partial") and dec.args:
+                return self.resolve(dec.args[0]) in _JIT_DECORATORS
+        return False
+
+    def _find_traced(self) -> set[ast.AST]:
+        """Functions whose body runs under a JAX trace: jit-decorated,
+        passed by name to a tracing callable, or nested inside one of
+        those."""
+        by_name: dict[str, list[ast.AST]] = {}
+        for f in self._functions:
+            by_name.setdefault(f.name, []).append(f)
+
+        traced: set[ast.AST] = set()
+        for f in self._functions:
+            if any(self._is_jit_decorator(d) for d in f.decorator_list):
+                traced.add(f)
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if self.resolve(call.func) not in _TRACING_CALLABLES:
+                continue
+            for arg in call.args[:1]:  # the function argument is arg 0
+                if isinstance(arg, ast.Name):
+                    for f in by_name.get(arg.id, []):
+                        traced.add(f)
+                elif isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+        # nested defs inside a traced function execute at trace time too
+        out = set(traced)
+        for f in self._functions:
+            cur = self.parents.get(f)
+            while cur is not None:
+                if cur in traced:
+                    out.add(f)
+                    break
+                cur = self.parents.get(cur)
+        return out
+
+    def is_traced(self, func: ast.AST) -> bool:
+        return func in self.traced
+
+    def traced_functions(
+        self,
+    ) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+        for f in self._functions:
+            if f in self.traced:
+                yield f
+
+
+def walk_body(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``func``'s subtree, pruning nested function/class definitions
+    (they get their own visit — a nested traced fn must not be analyzed
+    under its parent's taint set)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def param_names(func: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    args = func.args
+    names = [
+        a.arg
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+    ]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def tainted_names(
+    func: ast.FunctionDef | ast.AsyncFunctionDef,
+    inherited: set[str] | None = None,
+) -> set[str]:
+    """Names holding values derived from the function's parameters — the
+    tracer-carrying names at trace time. One forward pass in source order;
+    flow-insensitive (a name once tainted stays tainted). ``inherited``
+    seeds closure taint from enclosing traced functions."""
+    tainted = set(param_names(func)) | (inherited or set())
+
+    def rhs_tainted(expr: ast.AST) -> bool:
+        # static predicates over tracers (`x is None`, isinstance, shape/
+        # ndim/dtype comparisons) produce trace-time python bools — their
+        # targets are NOT tracers
+        if is_shape_guard(expr, tainted):
+            return False
+        return any(
+            isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id in tainted
+            for n in ast.walk(expr)
+        )
+
+    def taint_target(tgt: ast.AST) -> None:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store,)
+            ):
+                tainted.add(n.id)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and rhs_tainted(node.value):
+            for t in node.targets:
+                taint_target(t)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if rhs_tainted(node.value):
+                taint_target(node.target)
+        elif isinstance(node, ast.AugAssign) and rhs_tainted(node.value):
+            taint_target(node.target)
+        elif isinstance(node, ast.For) and rhs_tainted(node.iter):
+            taint_target(node.target)
+        elif isinstance(node, (ast.NamedExpr,)) and rhs_tainted(node.value):
+            taint_target(node.target)
+    return tainted
+
+
+def scope_taint(ctx: "ModuleContext", func: ast.AST) -> set[str]:
+    """Taint set for ``func`` including closure taint inherited from
+    enclosing TRACED functions (a nested traced fn sees its parents'
+    tracers). Untraced enclosing frames — jit FACTORIES like
+    ``make_train_step`` — contribute nothing: their params and locals are
+    static python values baked in at trace time."""
+    chain = []
+    cur = func
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if cur is func or ctx.is_traced(cur):
+                chain.append(cur)
+        cur = ctx.parents.get(cur)
+    tainted: set[str] = set()
+    for f in reversed(chain):  # outermost first
+        tainted = tainted_names(f, inherited=tainted)
+    return tainted
+
+
+def is_shape_guard(test: ast.AST, tainted: set[str]) -> bool:
+    """Branch tests that are legal at trace time even when they mention a
+    tracer NAME: ``x is None`` / ``is not None``, ``isinstance``/
+    ``hasattr`` checks, and attribute-only reads like ``x.ndim == 2``
+    (shapes/dtypes are static under trace). BoolOps are legal iff every
+    operand is."""
+    if isinstance(test, ast.BoolOp):
+        return all(is_shape_guard(v, tainted) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return is_shape_guard(test.operand, tainted)
+    if isinstance(test, ast.Call):
+        return dotted_name(test.func) in (
+            "isinstance",
+            "hasattr",
+            "callable",
+            "len",
+        )
+    if isinstance(test, ast.Compare):
+        nodes = [test.left, *test.comparators]
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops) and any(
+            isinstance(n, ast.Constant) and n.value is None for n in nodes
+        ):
+            return True
+        # shape/ndim/dtype attribute comparisons are static under trace
+        def static_side(n: ast.AST) -> bool:
+            if isinstance(n, ast.Constant):
+                return True
+            if isinstance(n, ast.Attribute):
+                return n.attr in ("ndim", "dtype", "size")
+            if isinstance(n, ast.Subscript) and isinstance(
+                n.value, ast.Attribute
+            ):
+                return n.value.attr == "shape"
+            if isinstance(n, ast.Call):
+                return dotted_name(n.func) in ("len",)
+            return False
+
+        return all(static_side(n) for n in nodes)
+    return False
+
+
+def concretizing_iter(expr: ast.AST, tainted: set[str]) -> Optional[str]:
+    """Tainted name whose iteration would concretize a tracer: the
+    ``range(n)`` / ``enumerate(x)`` / ``np.arange(n)`` patterns over a
+    tracer-derived value. Iterating CONTAINERS of tracers (pytrees,
+    ``jax.tree.leaves``, dict items, zips) is idiomatic JAX and exempt —
+    statically indistinguishable from array iteration, so the rule only
+    fires on the unambiguous length-concretizing forms."""
+    if isinstance(expr, ast.Call):
+        fn = dotted_name(expr.func)
+        if fn in ("range", "enumerate", "reversed") or (
+            fn is not None and fn.endswith(".arange")
+        ):
+            for a in expr.args:
+                name = mentions_tainted(a, tainted)
+                if name:
+                    return name
+    return None
+
+
+def mentions_tainted(expr: ast.AST, tainted: set[str]) -> Optional[str]:
+    """First tainted name loaded anywhere in ``expr`` (None if clean).
+    Attribute chains hanging off a tainted ROOT count (``x.T``); reads of
+    ``self.anything`` don't (self is never tainted)."""
+    for n in ast.walk(expr):
+        if (
+            isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id in tainted
+        ):
+            return n.id
+    return None

@@ -1,0 +1,124 @@
+"""Rule ``missing-donation``: jitted state rewriters without donation.
+
+A jitted function that consumes a buffer-holding argument and returns its
+replacement (``state -> new_state``, ``cache -> new_cache``) should donate
+that argument (``donate_argnums``): without it XLA must keep the input
+alive across the call, doubling the HBM footprint of the largest resident
+object (optimizer state in training, the KV cache in serving).
+
+Heuristic for "rewritten state": the wrapped function returns a name
+``new_<something>`` whose assignment references parameter ``p``, or the
+returned value is built from ``p.apply_gradients(...)`` / ``p.replace(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+    param_names,
+    walk_body,
+)
+
+RULE_ID = "missing-donation"
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit")
+_WRAPPERS = ("jax.vmap", "vmap", "jax.checkpoint", "jax.remat")
+
+
+def _returned_names(func: ast.AST) -> set[str]:
+    """Names that appear (possibly inside tuples) in return statements."""
+    out: set[str] = set()
+    for node in walk_body(func):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _rewritten_params(func: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    """Params the function's return value REPLACES (see module docstring)."""
+    params = param_names(func)
+    returned = _returned_names(func)
+    rewritten: set[str] = set()
+    for node in walk_body(func):
+        # new_x = <expr referencing param p>, with new_x returned
+        if isinstance(node, ast.Assign):
+            tgt_names = {
+                n.id
+                for t in node.targets
+                for n in ast.walk(t)
+                if isinstance(n, ast.Name)
+            }
+            fresh = {
+                t for t in tgt_names if t.startswith("new_") and t in returned
+            }
+            if fresh:
+                refs = {
+                    n.id
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Name)
+                }
+                rewritten |= params & refs
+        # p.apply_gradients(...) / p.replace(...) flowing to a return
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in ("apply_gradients", "replace"):
+                base = node.func.value
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                while isinstance(base, ast.Call):  # chained .replace()
+                    base = base.func
+                    if isinstance(base, ast.Attribute):
+                        base = base.value
+                if isinstance(base, ast.Name) and base.id in params:
+                    rewritten.add(base.id)
+    return rewritten
+
+
+def _unwrap_jitted_arg(ctx: ModuleContext, call: ast.Call):
+    """The function argument of a jit call, looking through one layer of
+    vmap/checkpoint wrapping: ``jax.jit(jax.vmap(f, ...))`` -> Name(f)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and ctx.resolve(arg.func) in _WRAPPERS:
+        arg = arg.args[0] if arg.args else None
+    return arg if isinstance(arg, ast.Name) else None
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    by_name: dict[str, list] = {}
+    for f in ctx.functions():
+        by_name.setdefault(f.name, []).append(f)
+
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if ctx.resolve(call.func) not in _JIT_NAMES:
+            continue
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in call.keywords
+        ):
+            continue
+        name_node = _unwrap_jitted_arg(ctx, call)
+        if name_node is None:
+            continue
+        for func in by_name.get(name_node.id, []):
+            rewritten = _rewritten_params(func)
+            if rewritten:
+                findings.append(Finding(
+                    RULE_ID, ctx.path, call.lineno, call.col_offset,
+                    ctx.qualname_of(call),
+                    f"jax.jit({name_node.id}) rewrites parameter(s) "
+                    f"{sorted(rewritten)} but passes no donate_argnums — "
+                    f"the input buffer stays live across the call",
+                ))
+                break
+    return findings

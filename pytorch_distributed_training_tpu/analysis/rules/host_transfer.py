@@ -1,0 +1,114 @@
+"""Rules ``host-transfer-traced`` and ``host-sync-in-loop``.
+
+Two flavors of the same disease — device values crossing to the host where
+they shouldn't:
+
+- **host-transfer-traced**: ``jax.device_get`` / ``.item()`` /
+  ``np.asarray``/``np.array`` / ``.block_until_ready()`` / ``float()``/
+  ``int()``/``bool()`` on a tracer inside a traced function. Under trace
+  these either throw a concretization error or silently bake a constant.
+- **host-sync-in-loop**: the same transfer calls inside a ``for``/
+  ``while`` body of HOST code in the hot subsystems (``train/``,
+  ``serve/``). Each one is a device sync serializing the dispatch stream
+  — the exact regressions that erase prefetch/warm-start wins.
+  Intentional syncs (per-step telemetry, epoch-boundary folds) get
+  waivers, so a new one showing up fails ``scripts/lint.py --check``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+    mentions_tainted,
+    scope_taint,
+    walk_body,
+)
+
+RULE_ID = "host-transfer-traced"
+LOOP_RULE_ID = "host-sync-in-loop"
+
+_TRANSFER_CALLS = ("jax.device_get", "numpy.asarray", "numpy.array")
+_TRANSFER_METHODS = ("item", "block_until_ready", "tolist", "__array__")
+_CONCRETIZERS = ("float", "int", "bool", "complex")
+
+# module-path fragments whose host loops are hot (dispatch-stream) code
+_HOT_SUBSYSTEMS = ("train/", "serve/", "train\\", "serve\\")
+
+
+def _transfer_call(ctx: ModuleContext, node: ast.Call) -> str | None:
+    """Describe ``node`` if it is a host-transfer call, else None."""
+    resolved = ctx.resolve(node.func)
+    if resolved in _TRANSFER_CALLS:
+        return resolved
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _TRANSFER_METHODS
+    ):
+        return f".{node.func.attr}()"
+    return None
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    # -------- traced scope: transfers on tracers ------------------------
+    for func in ctx.traced_functions():
+        tainted = scope_taint(ctx, func)
+        qual = ctx.qualnames.get(func, func.name)
+        for node in walk_body(func):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _transfer_call(ctx, node)
+            if what is not None:
+                target = node.args[0] if node.args else node.func
+                if mentions_tainted(target, tainted):
+                    findings.append(Finding(
+                        RULE_ID, ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f"host transfer `{what}` on a tracer inside a "
+                        f"traced function",
+                    ))
+                continue
+            resolved = ctx.resolve(node.func)
+            if (
+                resolved in _CONCRETIZERS
+                and node.args
+                and mentions_tainted(node.args[0], tainted)
+            ):
+                findings.append(Finding(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset, qual,
+                    f"`{resolved}()` concretizes a tracer inside a traced "
+                    f"function",
+                ))
+
+    # -------- host hot loops: syncs in train/ and serve/ ----------------
+    path = ctx.path.replace("\\", "/")
+    if not any(s in path for s in ("train/", "serve/")):
+        return findings
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = ctx.enclosing_function(node)
+        if func is not None and ctx.is_traced(func):
+            continue  # traced code handled above
+        # in a loop body of the SAME function?
+        in_loop = False
+        cur = ctx.parents.get(node)
+        while cur is not None and cur is not func:
+            if isinstance(cur, (ast.For, ast.While)):
+                in_loop = True
+                break
+            cur = ctx.parents.get(cur)
+        if not in_loop:
+            continue
+        what = _transfer_call(ctx, node)
+        if what is not None and what != ".block_until_ready()":
+            findings.append(Finding(
+                LOOP_RULE_ID, ctx.path, node.lineno, node.col_offset,
+                ctx.qualname_of(node),
+                f"host sync `{what}` inside a hot-path loop — one device "
+                f"round-trip per iteration serializes the dispatch stream",
+            ))
+    return findings

@@ -1,0 +1,59 @@
+"""Rule ``impure-call``: host-side impurity under a traced scope.
+
+``time.time()``, stdlib ``random.*``, ``np.random.*`` etc. inside a
+traced function execute ONCE at trace time and bake their value into the
+compiled program — every subsequent call replays the stale constant. The
+JAX-native alternatives: thread ``jax.random`` keys for randomness, pass
+host timestamps in as arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+    walk_body,
+)
+
+RULE_ID = "impure-call"
+
+# resolved dotted-name prefixes whose call is impure under a trace
+_IMPURE_PREFIXES = (
+    "time.",
+    "random.",
+    "numpy.random.",
+    "np.random.",
+    "os.urandom",
+    "secrets.",
+    "uuid.",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.date.today",
+)
+
+
+def _is_impure(resolved: str) -> bool:
+    return any(
+        resolved == p.rstrip(".") or resolved.startswith(p)
+        for p in _IMPURE_PREFIXES
+    )
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for func in ctx.traced_functions():
+        qual = ctx.qualnames.get(func, func.name)
+        for node in walk_body(func):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved and _is_impure(resolved):
+                findings.append(Finding(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset, qual,
+                    f"impure call `{resolved}` inside a traced function — "
+                    f"its value is baked in at trace time (use jax.random "
+                    f"keys / pass host values as arguments)",
+                ))
+    return findings

@@ -1,0 +1,98 @@
+"""Rule ``prng-reuse``: the same PRNG key consumed twice.
+
+``jax.random`` keys are use-once values: drawing two samples from one key
+yields correlated (identical-stream) randomness. A key name bound from
+``key``/``PRNGKey``/``split``/``fold_in`` may feed exactly one
+distribution call; the second consumption without an intervening
+rebind/``split``/``fold_in`` is flagged. Deriving calls (``split``,
+``fold_in``, ``key_data``...) never count as consumption.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+)
+
+RULE_ID = "prng-reuse"
+
+_KEY_MAKERS = (
+    "jax.random.key",
+    "jax.random.PRNGKey",
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+    "jax.random.wrap_key_data",
+)
+# non-consuming key plumbing
+_DERIVERS = {
+    "key",
+    "PRNGKey",
+    "split",
+    "fold_in",
+    "clone",
+    "key_data",
+    "wrap_key_data",
+    "key_impl",
+}
+
+
+def _is_key_maker(resolved: str | None) -> bool:
+    return resolved in _KEY_MAKERS
+
+
+def _is_random_consumer(resolved: str | None) -> bool:
+    if not resolved or not resolved.startswith("jax.random."):
+        return False
+    return resolved.rsplit(".", 1)[-1] not in _DERIVERS
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for func in ctx.functions():
+        qual = ctx.qualnames.get(func, func.name)
+        key_names: set[str] = set()
+        consumed: dict[str, int] = {}  # name -> line of first consumption
+
+        # events in source order: assignments binding keys, and random calls
+        events: list[tuple[int, int, str, ast.AST]] = []
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _is_key_maker(ctx.resolve(node.value.func)):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                events.append(
+                                    (n.lineno, n.col_offset, "bind", n)
+                                )
+            elif isinstance(node, ast.Call) and _is_random_consumer(
+                ctx.resolve(node.func)
+            ):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    events.append(
+                        (node.lineno, node.col_offset, "consume", node)
+                    )
+        events.sort(key=lambda e: (e[0], e[1]))
+        for lineno, col, kind, node in events:
+            if kind == "bind":
+                key_names.add(node.id)
+                consumed.pop(node.id, None)
+            else:
+                name = node.args[0].id
+                if name not in key_names:
+                    continue
+                if name in consumed:
+                    findings.append(Finding(
+                        RULE_ID, ctx.path, lineno, col, qual,
+                        f"PRNG key `{name}` reused (first consumed at line "
+                        f"{consumed[name]}) — split/fold_in before drawing "
+                        f"again",
+                    ))
+                else:
+                    consumed[name] = lineno
+    return findings

@@ -1,0 +1,72 @@
+"""Rule ``traced-branch``: Python control flow on tracer-derived values.
+
+``if``/``while``/``for`` (and comprehensions) over a value derived from a
+traced function's parameters concretize the tracer — either a
+``TracerBoolConversionError`` at trace time or, worse with weak types and
+``static_argnums`` drift, a silent per-value recompile. The structural
+alternatives are ``jax.lax.cond``/``select``/``while_loop``/``scan``.
+
+Exempt: trace-time-legal tests (``x is None``, ``isinstance``/``hasattr``,
+shape/ndim/dtype comparisons — see ``common.is_shape_guard``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+    concretizing_iter,
+    is_shape_guard,
+    mentions_tainted,
+    scope_taint,
+    walk_body,
+)
+
+RULE_ID = "traced-branch"
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for func in ctx.traced_functions():
+        tainted = scope_taint(ctx, func)
+        qual = ctx.qualnames.get(func, func.name)
+        for node in walk_body(func):
+            if isinstance(node, (ast.If, ast.While)):
+                if is_shape_guard(node.test, tainted):
+                    continue
+                name = mentions_tainted(node.test, tainted)
+                if name:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(Finding(
+                        RULE_ID, ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f"Python `{kind}` on tracer-derived `{name}` inside "
+                        f"a traced function — use jax.lax.cond/select/"
+                        f"while_loop",
+                    ))
+            elif isinstance(node, ast.For):
+                name = concretizing_iter(node.iter, tainted)
+                if name:
+                    findings.append(Finding(
+                        RULE_ID, ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f"Python `for` over a length concretized from "
+                        f"tracer-derived `{name}` inside a traced function "
+                        f"— use jax.lax.scan/fori_loop",
+                    ))
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    name = concretizing_iter(gen.iter, tainted)
+                    if name:
+                        findings.append(Finding(
+                            RULE_ID, ctx.path, node.lineno, node.col_offset,
+                            qual,
+                            f"comprehension over a length concretized from "
+                            f"tracer-derived `{name}` inside a traced "
+                            f"function",
+                        ))
+                        break
+    return findings

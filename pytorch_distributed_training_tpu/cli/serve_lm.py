@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode)")
     p.add_argument("--metrics-dir", default=None,
                    help="stream serve telemetry (JSONL) under this directory")
+    p.add_argument("--guards", default=None,
+                   choices=("off", "record", "strict"),
+                   help="runtime correctness guards (analysis/guards.py): "
+                        "record (default) emits recompile/implicit-transfer "
+                        "telemetry; strict also fails the serve loop; "
+                        "default comes from PDT_TPU_GUARDS")
     return p
 
 
@@ -106,11 +112,19 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         ),
         max_new_tokens=args.max_new_tokens_cap,
     )
+    from pytorch_distributed_training_tpu.analysis.guards import (
+        GuardSet,
+        guard_mode_from_env,
+    )
+
     server = InferenceServer(
         model, params, config,
         queue_depth=args.queue_depth,
         default_deadline_s=args.deadline_s or None,
         registry=registry,
+        guards=GuardSet(
+            mode=args.guards or guard_mode_from_env(), registry=registry
+        ),
     ).start()
 
     try:

@@ -59,6 +59,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_training_tpu.analysis.guards import (
+    GuardSet,
+    guard_mode_from_env,
+)
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
 from pytorch_distributed_training_tpu.serve.queue import GenRequest, RequestQueue
 from pytorch_distributed_training_tpu.utils.logging import get_logger
@@ -136,6 +140,7 @@ class DecodeEngine:
         queue: RequestQueue,
         *,
         registry=None,
+        guards: Optional[GuardSet] = None,
     ):
         cfg = model.config
         if not cfg.causal:
@@ -168,6 +173,15 @@ class DecodeEngine:
 
             registry = get_registry()
         self._registry = registry
+        # Runtime guards (analysis/guards.py): each compiled entry point is
+        # wrapped so a retrace after its warm-up compile — one prefill per
+        # bucket, one decode step — is a recorded violation, and warm calls
+        # run under the implicit-transfer guard (strict mode: an un-placed
+        # host array reaching a hot call raises instead of silently paying
+        # a per-tick H2D copy).
+        self._guards = guards or GuardSet(
+            mode=guard_mode_from_env(), registry=registry
+        )
 
         # Per-slot cache template comes from a batch-1 abstract init at the
         # full cache length (no params materialized); the resident cache
@@ -232,7 +246,12 @@ class DecodeEngine:
             )[0, 0, :].astype(jnp.float32)
             return last, new_cache
 
-        fn = jax.jit(prefill)
+        # the resident cache is rewritten every prefill: donate it so XLA
+        # updates the slot in place instead of holding a second full
+        # [num_slots, ...] cache alive across the call
+        fn = self._guards.wrap_jit(
+            f"serve_prefill_b{bucket}", jax.jit(prefill, donate_argnums=(1,))
+        )
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -254,8 +273,13 @@ class DecodeEngine:
             )
             return logits[0, 0, :].astype(jnp.float32), new_cache
 
-        self._decode_fn = jax.jit(
-            jax.vmap(one, in_axes=(None, 0, 0, 0))
+        # cache donated for the same reason as prefill: the decode tick
+        # consumes the whole resident cache and returns its replacement
+        self._decode_fn = self._guards.wrap_jit(
+            "serve_decode",
+            jax.jit(
+                jax.vmap(one, in_axes=(None, 0, 0, 0)), donate_argnums=(1,)
+            ),
         )
         return self._decode_fn
 
@@ -371,7 +395,9 @@ class DecodeEngine:
                 jnp.asarray(padded),
                 jnp.asarray(req.prompt_len, jnp.int32),
             )
-            logits = np.asarray(last)
+            # explicit d2h (np.asarray would be an implicit transfer — the
+            # exact pattern the transfer guard disallows on real chips)
+            logits = jax.device_get(last)
         token = self._sample(req, logits)
         self._emit_token(req, token)
         if self._is_terminal(req, token):
@@ -419,7 +445,16 @@ class DecodeEngine:
             req = self._queue.pop_ready()
             if req is None:
                 break
-            self._admit(req, slot)
+            try:
+                self._admit(req, slot)
+            except Exception:
+                # the request is already popped and not yet slotted: an
+                # admission failure (guard violation, wedged prefill, OOM)
+                # must not orphan it — its waiter would hang forever while
+                # the loop's failure path cancels only queued+slotted work
+                self._registry.inc("serve/admit_failures")
+                self._finish(req, "error", "admit_failure")
+                raise
             worked = True
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
@@ -437,7 +472,7 @@ class DecodeEngine:
                     jnp.asarray(tokens),
                     jnp.asarray(mask),
                 )
-                self._last_logits = np.asarray(logits)
+                self._last_logits = jax.device_get(logits)
             for i in active:
                 s = self._slots[i]
                 s.steps_done += 1
@@ -490,4 +525,7 @@ class DecodeEngine:
             "num_slots": self.config.num_slots,
             "prompt_buckets": list(self.config.prompt_buckets),
             "compiled_prefill_buckets": sorted(self._prefill_fns),
+            "guard_mode": self._guards.mode,
+            "guard_recompiles": self._guards.recompile_violations,
+            "guard_implicit_transfers": self._guards.transfer_violations,
         }

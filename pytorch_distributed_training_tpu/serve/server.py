@@ -59,6 +59,7 @@ class InferenceServer:
         queue_depth: int = 16,
         default_deadline_s: Optional[float] = None,
         registry=None,
+        guards=None,
     ):
         self.queue = RequestQueue(
             max_depth=queue_depth,
@@ -66,7 +67,8 @@ class InferenceServer:
             max_new_tokens=config.max_new_tokens,
         )
         self.engine = DecodeEngine(
-            model, params, config, self.queue, registry=registry
+            model, params, config, self.queue, registry=registry,
+            guards=guards,
         )
         self.default_deadline_s = default_deadline_s
         self._ids = itertools.count()

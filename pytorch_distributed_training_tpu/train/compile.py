@@ -79,6 +79,8 @@ def aot_warm_start(
     train_pspec,
     eval_pspec,
     cache_dir: str | None = None,
+    registry=None,
+    guard_mode: str = "off",
 ):
     """AOT-compile the steps against abstract batches; returns
     ``(compiled_train, compiled_eval, record)``.
@@ -87,6 +89,13 @@ def aot_warm_start(
     ``state`` is the concrete (already sharded) TrainState, which pins the
     state avals exactly. Raises on lowering/compile failure — the caller
     decides whether to fall back to the lazy jit path.
+
+    With ``guard_mode`` != "off" the compiled train step gets the
+    post-lower donation audit (analysis/guards.py): the step donates its
+    state, and an executable that aliases nothing means XLA dropped the
+    donation — optimizer state would sit double-resident in HBM. The
+    audit emits a ``donation_audit`` record through ``registry`` (strict:
+    raises).
     """
     entries_before = cache_entry_count(cache_dir)
     t0 = time.perf_counter()
@@ -94,6 +103,15 @@ def aot_warm_start(
         state, _attach_shardings(train_spec, mesh, train_pspec)
     ).compile()
     train_s = time.perf_counter() - t0
+    if guard_mode != "off":
+        from pytorch_distributed_training_tpu.analysis.guards import (
+            donation_audit,
+        )
+
+        donation_audit(
+            "train_step", compiled_train,
+            registry=registry, mode=guard_mode,
+        )
     t0 = time.perf_counter()
     compiled_eval = eval_step.lower(
         state, _attach_shardings(eval_spec, mesh, eval_pspec)

@@ -24,6 +24,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_training_tpu.analysis.guards import (
+    GuardSet,
+    GuardViolation,
+    guard_mode_from_env,
+    sharding_audit,
+)
 from pytorch_distributed_training_tpu.comms import initialize
 from pytorch_distributed_training_tpu.comms.mesh import build_mesh
 from pytorch_distributed_training_tpu.faults.inject import get_plan
@@ -110,6 +116,13 @@ class Trainer:
         )
         self.registry = MetricsRegistry()
         set_registry(self.registry)
+        # Runtime correctness guards (analysis/guards.py): recompile
+        # detection around the jitted steps, transfer-guard arming (strict),
+        # donation/sharding audits. PDT_TPU_GUARDS overrides the config.
+        self.guards = GuardSet(
+            mode=guard_mode_from_env(default=train_config.guards),
+            registry=self.registry,
+        )
         self.metrics_sink = None
         self._first_step_done = False
         self._log_pending = None  # (step, device loss) awaiting a non-blocking fetch
@@ -357,6 +370,16 @@ class Trainer:
                     objective=self.objective,
                 )
             )
+        if self.guards.mode != "off":
+            # committed placement is final: large params still fully
+            # replicated on a sharded (fsdp/model/stage) mesh mean the
+            # policy silently didn't apply — record it (strict: raise).
+            # After the run-metadata emit so the stream keeps its
+            # header-first contract.
+            sharding_audit(
+                self.state.params, self.mesh,
+                registry=self.registry, mode=self.guards.mode,
+            )
 
     def _make_loader(self, data, train_config, *, train: bool):
         """ONE loader factory for both splits: the native C++ prefetching
@@ -455,6 +478,13 @@ class Trainer:
             # abstract batch specs, so epoch 0's first step is a normal
             # steady-state step and compile wall time gets its own record
             self._warm_start()
+        if self.guards.mode != "off":
+            # guard the compiled entry points: a retrace after warm-up (or,
+            # strict, an implicit transfer inside a warm call) is a recorded
+            # violation. Wrapped AFTER the warm start so .lower() above saw
+            # the raw jit objects; the wrapper forwards everything else.
+            self.train_step = self.guards.wrap_jit("train_step", self.train_step)
+            self.eval_step = self.guards.wrap_jit("eval_step", self.eval_step)
         # Hung-step watchdog: armed around device-blocking sections here and
         # (via the module install) around checkpoint joins + host collectives
         self.watchdog = (
@@ -543,7 +573,13 @@ class Trainer:
                 train_pspec=TRAIN_BATCH_PSPEC,
                 eval_pspec=P(BATCH_AXES),
                 cache_dir=self.compile_cache_dir,
+                registry=self.registry,
+                guard_mode=self.guards.mode,
             )
+        except GuardViolation:
+            # a strict donation-audit failure is a finding, not a compile
+            # hiccup — don't swallow it into the lazy-jit fallback
+            raise
         except Exception as e:  # noqa: BLE001 — warm start is best-effort
             log0(f"AOT warm start failed ({e!r}); first step compiles lazily")
             return

@@ -412,6 +412,14 @@ class TrainConfig:
     # activation memory (gpt2-medium at micro 8 OOMs unrolled, fits rolled
     # — NOTES.md round-4); "on" forces unrolling regardless of count.
     unroll_accum: str = "auto"
+    # Runtime correctness guards (analysis/guards.py): "record" (default)
+    # wraps the train/eval steps with a recompile counter (a retrace after
+    # the warm-up compile emits a `recompile` telemetry record) and runs
+    # post-lower donation + sharding audits; "strict" additionally arms
+    # jax.transfer_guard("disallow") around warm step calls and raises on
+    # any violation (what the tier-1 guard tests run under); "off" disables
+    # the layer. PDT_TPU_GUARDS overrides the default.
+    guards: str = "record"
     # Dropout-key PRNG: "rbg" rides the TPU hardware generator (profiled
     # ~1.5x step speedup over threefry on bert-large — threefry's bit
     # arithmetic competes with the matmuls for VPU cycles); "threefry2x32"

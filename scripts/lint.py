@@ -1,0 +1,102 @@
+"""JAX correctness linter CLI (analysis/lint.py driver).
+
+    python scripts/lint.py                 # report findings (waivers applied)
+    python scripts/lint.py --check        # exit 1 unless the tree is clean
+    python scripts/lint.py --json         # machine-readable report
+    python scripts/lint.py serve/ train/  # lint a subset
+
+Every finding must be fixed or waived: ``analysis/waivers.toml`` holds
+``[[waiver]]`` entries (rule + file [+ symbol] + mandatory reason). With
+``--metrics-dir`` the run appends a ``lint_summary`` record to the same
+telemetry JSONL stream training/serving write, so lint health shows up in
+``scripts/summarize_metrics.py``.
+
+``--check`` is part of the standard verify flow (see README "Static
+analysis & guards"): the tree must lint clean, modulo waivers, to merge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_training_tpu.analysis.lint import (  # noqa: E402
+    DEFAULT_WAIVERS,
+    REPO_ROOT,
+    lint_paths,
+    summary_record,
+)
+from pytorch_distributed_training_tpu.analysis.waivers import (  # noqa: E402
+    load_waivers,
+)
+
+DEFAULT_PATHS = [os.path.join(REPO_ROOT, "pytorch_distributed_training_tpu")]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any unwaived finding (or parse error) "
+                        "remains")
+    p.add_argument("--json", action="store_true",
+                   help="print the full report as JSON")
+    p.add_argument("--waivers", default=DEFAULT_WAIVERS,
+                   help="waiver file (TOML subset; see analysis/waivers.py)")
+    p.add_argument("--no-waivers", action="store_true",
+                   help="ignore the waiver file (show every raw finding)")
+    p.add_argument("--metrics-dir", default=None,
+                   help="append a lint_summary record to this telemetry dir")
+    args = p.parse_args(argv)
+
+    waivers = []
+    if not args.no_waivers and os.path.exists(args.waivers):
+        waivers = load_waivers(args.waivers)
+    report = lint_paths(args.paths or DEFAULT_PATHS, waivers)
+    summary = summary_record(report)
+
+    if args.metrics_dir:
+        from pytorch_distributed_training_tpu.telemetry.sink import JsonlSink
+
+        sink = JsonlSink(args.metrics_dir)
+        sink.emit(summary)
+        sink.close()
+
+    if args.json:
+        print(json.dumps({
+            **summary,
+            "findings_detail": [vars(f) for f in report.findings],
+            "waived_detail": [
+                {**vars(f), "reason": w.reason} for f, w in report.waived
+            ],
+            "unused_waivers": [vars(w) for w in report.unused_waivers],
+            "errors": report.errors,
+        }, indent=1))
+    else:
+        for f in report.findings:
+            print(f.format())
+        for e in report.errors:
+            print(f"ERROR {e}")
+        for w in report.unused_waivers:
+            print(
+                f"warning: unused waiver rule={w.rule} file={w.file} "
+                f"symbol={w.symbol}", file=sys.stderr,
+            )
+        print(
+            f"{report.files} files: {len(report.findings)} finding(s), "
+            f"{len(report.waived)} waived, "
+            f"{len(report.unused_waivers)} unused waiver(s)"
+        )
+
+    if args.check and not report.clean:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

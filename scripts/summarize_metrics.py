@@ -56,6 +56,7 @@ def summarize(records: list[dict]) -> dict:
     saves = [r for r in records if r.get("record") == "checkpoint_save"]
     restarts = [r for r in records if r.get("record") == "restart"]
     compiles = [r for r in records if r.get("record") == "compile"]
+    guards = summarize_guards(records)
 
     epochs = []
     for r in records:
@@ -109,7 +110,42 @@ def summarize(records: list[dict]) -> dict:
         "checkpoint_saves": len(saves),
         "restarts": len(restarts),
         "serve": summarize_serve(records),
+        "guards": guards,
     }
+
+
+def summarize_guards(records: list[dict]) -> dict | None:
+    """Fold guard-layer records (analysis/guards.py) + the last
+    ``lint_summary`` into one violations block; None when the stream holds
+    no guard-layer records at all (guards off / pre-guard stream)."""
+    recompiles = [r for r in records if r.get("record") == "recompile"]
+    transfers = [
+        r for r in records if r.get("record") == "implicit_transfer"
+    ]
+    donations = [r for r in records if r.get("record") == "donation_audit"]
+    shardings = [r for r in records if r.get("record") == "sharding_audit"]
+    lints = [r for r in records if r.get("record") == "lint_summary"]
+    if not (recompiles or transfers or donations or shardings or lints):
+        return None
+    out: dict = {
+        "recompiles": len(recompiles),
+        "recompiled_fns": sorted({r.get("name") for r in recompiles}),
+        "implicit_transfers": len(transfers),
+        "donation_audits_failed": sum(
+            1 for r in donations if r.get("ok") is False
+        ),
+        "sharding_audits_failed": sum(
+            1 for r in shardings if r.get("ok") is False
+        ),
+    }
+    if lints:
+        last = lints[-1]
+        out["lint"] = {
+            "findings": last.get("findings"),
+            "waived": last.get("waived"),
+            "clean": last.get("clean"),
+        }
+    return out
 
 
 def _pcts(values: list) -> dict | None:
@@ -266,6 +302,29 @@ def render_table(summary: dict) -> str:
             lines.append(render_serve_table(serve))
         else:  # pure serving stream: the serve table IS the output
             lines = [render_serve_table(serve)]
+    guards = summary.get("guards")
+    if guards:
+        bad = (
+            guards["recompiles"] or guards["implicit_transfers"]
+            or guards["donation_audits_failed"]
+            or guards["sharding_audits_failed"]
+        )
+        gl = (
+            f"guards: recompiles={guards['recompiles']}"
+            + (f" ({','.join(guards['recompiled_fns'])})"
+               if guards["recompiled_fns"] else "")
+            + f" implicit-transfers={guards['implicit_transfers']}"
+            + f" donation-fails={guards['donation_audits_failed']}"
+            + f" sharding-fails={guards['sharding_audits_failed']}"
+            + (" [VIOLATIONS]" if bad else " [clean]")
+        )
+        lint = guards.get("lint")
+        if lint:
+            gl += (
+                f"  lint: {_fmt(lint.get('findings'))} finding(s), "
+                f"{_fmt(lint.get('waived'))} waived"
+            )
+        lines.append(gl)
     return "\n".join(lines)
 
 

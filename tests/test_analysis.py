@@ -1,0 +1,440 @@
+"""Static linter tests (analysis/lint.py + rules/): one positive and one
+negative fixture per rule so rule regressions are caught, waiver-file
+mechanics, and the repo-lints-clean gate that mirrors
+``scripts/lint.py --check``. CPU-only, tier-1."""
+
+import os
+import textwrap
+
+import pytest
+
+from pytorch_distributed_training_tpu.analysis.lint import (
+    DEFAULT_WAIVERS,
+    REPO_ROOT,
+    lint_paths,
+    lint_source,
+    summary_record,
+)
+from pytorch_distributed_training_tpu.analysis.waivers import (
+    load_waivers,
+    parse_waivers_toml,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def rules_of(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src))]
+
+
+# ------------------------------------------------------------ traced-branch
+
+
+def test_traced_branch_flags_if_on_tracer():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert rules_of(src) == ["traced-branch"]
+
+
+def test_traced_branch_flags_fn_passed_to_jit_and_while():
+    src = """
+    import jax
+
+    def step(state, batch):
+        y = state + batch
+        while y < 3:
+            y = y + 1
+        return y
+
+    step_j = jax.jit(step, donate_argnums=(0,))
+    """
+    assert rules_of(src) == ["traced-branch"]
+
+
+def test_traced_branch_flags_range_over_tracer():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(n, x):
+        for _ in range(n):
+            x = x + 1
+        return x
+    """
+    assert rules_of(src) == ["traced-branch"]
+
+
+def test_traced_branch_negative_static_and_host():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x, mask=None):
+        if mask is None:              # None-check: static under trace
+            mask = x * 0
+        if x.ndim == 2:               # shape guard: static under trace
+            x = x[None]
+        for leaf in jax.tree.leaves({"a": x}):   # container iteration: fine
+            mask = mask + leaf
+        return mask
+
+    def host(flag, items):
+        if flag:                      # not traced at all
+            return [i for i in items]
+        return []
+    """
+    assert rules_of(src) == []
+
+
+def test_traced_branch_factory_closure_is_static():
+    """A jit FACTORY's params are trace-time constants: branching on them
+    inside the returned (traced) step is legal."""
+    src = """
+    import jax
+
+    def make_step(log_extra):
+        def step(state, batch):
+            out = state + batch
+            if log_extra:
+                out = out * 2
+            return out
+        return jax.jit(step, donate_argnums=(0,))
+    """
+    assert rules_of(src) == []
+
+
+# -------------------------------------------------------------- impure-call
+
+
+def test_impure_call_flags_time_and_np_random():
+    src = """
+    import time
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def f(x):
+        t = time.time()
+        noise = np.random.normal(size=3)
+        return x + t + noise
+    """
+    assert rules_of(src).count("impure-call") == 2
+
+
+def test_impure_call_negative_host_and_jax_random():
+    src = """
+    import time
+    import jax
+
+    def host_loop():
+        return time.time()
+
+    @jax.jit
+    def f(x, key):
+        return x + jax.random.normal(key, x.shape)
+    """
+    assert "impure-call" not in rules_of(src)
+
+
+# ------------------------------------------------------ host-transfer-traced
+
+
+def test_host_transfer_flags_device_get_and_item_in_traced():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        host = jax.device_get(x)
+        v = x.item()
+        return np.asarray(x) + host + v
+    """
+    assert rules_of(src).count("host-transfer-traced") == 3
+
+
+def test_host_transfer_negative_untraced():
+    src = """
+    import jax
+    import numpy as np
+
+    def host(x):
+        return float(np.asarray(jax.device_get(x)).mean())
+    """
+    assert "host-transfer-traced" not in rules_of(src)
+
+
+# --------------------------------------------------------- host-sync-in-loop
+
+
+def _lint_named(src, relpath):
+    return [
+        f.rule for f in lint_source(textwrap.dedent(src), path=relpath)
+    ]
+
+
+def test_host_sync_in_loop_flags_train_subsystem():
+    src = """
+    import jax
+
+    def epoch_loop(batches, step, state):
+        for b in batches:
+            state, loss = step(state, b)
+            print(float(jax.device_get(loss)))
+        return state
+    """
+    rules = _lint_named(src, "pytorch_distributed_training_tpu/train/x.py")
+    assert rules == ["host-sync-in-loop"]
+
+
+def test_host_sync_in_loop_ignores_other_subsystems():
+    src = """
+    import jax
+
+    def epoch_loop(batches, step, state):
+        for b in batches:
+            state, loss = step(state, b)
+            print(float(jax.device_get(loss)))
+        return state
+    """
+    assert _lint_named(
+        src, "pytorch_distributed_training_tpu/data/x.py"
+    ) == []
+
+
+# ----------------------------------------------------------- missing-donation
+
+
+def test_missing_donation_flags_state_rewriter():
+    src = """
+    import jax
+
+    def step(state, batch):
+        new_state = state.apply_gradients(batch)
+        return new_state
+
+    step_j = jax.jit(step)
+    """
+    assert "missing-donation" in rules_of(src)
+
+
+def test_missing_donation_flags_through_vmap():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def one(cache, tok):
+        new_cache = jax.tree.map(lambda c: c + tok, cache)
+        return new_cache
+
+    decode = jax.jit(jax.vmap(one, in_axes=(0, 0)))
+    """
+    assert "missing-donation" in rules_of(src)
+
+
+def test_missing_donation_negative_donated_or_pure():
+    src = """
+    import jax
+
+    def step(state, batch):
+        new_state = state.apply_gradients(batch)
+        return new_state
+
+    def metric(state, batch):
+        return (state * batch).sum()
+
+    step_j = jax.jit(step, donate_argnums=(0,))
+    metric_j = jax.jit(metric)
+    """
+    assert "missing-donation" not in rules_of(src)
+
+
+# ---------------------------------------------------------------- prng-reuse
+
+
+def test_prng_reuse_flags_double_draw():
+    src = """
+    import jax
+
+    def f(seed, shape):
+        key = jax.random.key(seed)
+        a = jax.random.normal(key, shape)
+        b = jax.random.uniform(key, shape)
+        return a + b
+    """
+    assert rules_of(src) == ["prng-reuse"]
+
+
+def test_prng_reuse_negative_split_and_fold():
+    src = """
+    import jax
+
+    def f(seed, shape):
+        key = jax.random.key(seed)
+        a_key, b_key = jax.random.split(key)
+        a = jax.random.normal(a_key, shape)
+        key = jax.random.fold_in(b_key, 1)     # rebind: fresh key
+        b = jax.random.uniform(key, shape)
+        c = jax.random.fold_in(key, 2)         # deriving, not consuming
+        return a + b, c
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------------ mutable-default
+
+
+def test_mutable_default_flags_list_dict():
+    src = """
+    def f(x, acc=[], opts={}):
+        acc.append(x)
+        return acc, opts
+    """
+    assert rules_of(src) == ["mutable-default", "mutable-default"]
+
+
+def test_mutable_default_negative():
+    src = """
+    def f(x, acc=None, shape=(1, 2)):
+        return acc or [x], shape
+    """
+    assert rules_of(src) == []
+
+
+# -------------------------------------------------------------------- waivers
+
+
+def test_waiver_parse_match_and_errors(tmp_path):
+    text = textwrap.dedent("""
+    # comment
+    [[waiver]]
+    rule = "prng-reuse"
+    file = "pkg/sub/*.py"
+    symbol = "Klass.method"
+    reason = "keys are per-request streams"
+    """)
+    (w,) = parse_waivers_toml(text)
+    assert w.rule == "prng-reuse" and w.symbol == "Klass.method"
+
+    from pytorch_distributed_training_tpu.analysis.rules.common import (
+        Finding,
+    )
+
+    hit = Finding("prng-reuse", "pkg/sub/mod.py", 1, 0,
+                  "Klass.method.inner", "m")
+    miss_rule = Finding("impure-call", "pkg/sub/mod.py", 1, 0,
+                        "Klass.method", "m")
+    miss_sym = Finding("prng-reuse", "pkg/sub/mod.py", 1, 0,
+                       "Klass.methodical", "m")
+    assert w.matches(hit)
+    assert not w.matches(miss_rule)
+    assert not w.matches(miss_sym)
+
+    with pytest.raises(ValueError, match="missing"):
+        parse_waivers_toml('[[waiver]]\nrule = "x"\nfile = "y"')
+    with pytest.raises(ValueError, match="unsupported waiver syntax"):
+        parse_waivers_toml("[[waiver]]\nrule = [1, 2]")
+    with pytest.raises(ValueError, match="outside"):
+        parse_waivers_toml('rule = "x"')
+
+
+def test_lint_paths_applies_waivers_and_reports_unused(tmp_path):
+    bad = tmp_path / "train" / "hot.py"
+    bad.parent.mkdir()
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        def loop(batches, state, step):
+            for b in batches:
+                state = step(state, b)
+                print(jax.device_get(state))
+            return state
+    """))
+    report = lint_paths([str(tmp_path)])
+    assert [f.rule for f in report.findings] == ["host-sync-in-loop"]
+    assert not report.clean
+
+    waivers = parse_waivers_toml(textwrap.dedent("""
+        [[waiver]]
+        rule = "host-sync-in-loop"
+        file = "*train/hot.py"
+        reason = "test fixture"
+
+        [[waiver]]
+        rule = "impure-call"
+        file = "nowhere/*.py"
+        reason = "dead entry"
+    """))
+    report = lint_paths([str(tmp_path)], waivers)
+    assert report.clean and len(report.waived) == 1
+    assert [w.rule for w in report.unused_waivers] == ["impure-call"]
+
+    rec = summary_record(report)
+    assert rec["record"] == "lint_summary"
+    assert rec["findings"] == 0 and rec["waived"] == 1
+    assert rec["unused_waivers"] == 1 and rec["clean"]
+
+
+def test_lint_reports_parse_errors(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    report = lint_paths([str(tmp_path)])
+    assert not report.clean and "broken.py" in report.errors[0]
+
+
+# -------------------------------------------------------------- the repo gate
+
+
+def test_repo_lints_clean():
+    """The acceptance gate: the whole package lints clean modulo the
+    documented waivers — and no waiver has rotted into uselessness. This
+    is ``scripts/lint.py --check`` as a tier-1 test."""
+    package = os.path.join(REPO_ROOT, "pytorch_distributed_training_tpu")
+    report = lint_paths([package], load_waivers(DEFAULT_WAIVERS))
+    assert report.errors == []
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+    assert report.unused_waivers == [], [
+        (w.rule, w.file, w.symbol) for w in report.unused_waivers
+    ]
+
+
+def test_lint_cli_check(tmp_path, capsys):
+    """scripts/lint.py --check: exit 0 on the real tree, 1 on a dirty one,
+    and --metrics-dir writes a lint_summary record."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_cli", os.path.join(REPO_ROOT, "scripts", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    mdir = str(tmp_path / "metrics")
+    assert mod.main(["--check", "--metrics-dir", mdir]) == 0
+    capsys.readouterr()
+    import json
+
+    with open(os.path.join(mdir, "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    assert any(r.get("record") == "lint_summary" for r in recs)
+
+    dirty = tmp_path / "serve" / "bad.py"
+    dirty.parent.mkdir()
+    dirty.write_text(
+        "import jax\n"
+        "def loop(xs, s, step):\n"
+        "    for x in xs:\n"
+        "        s = step(s, x)\n"
+        "        jax.device_get(s)\n"
+        "    return s\n"
+    )
+    assert mod.main(["--check", str(tmp_path / "serve")]) == 1
+    capsys.readouterr()
