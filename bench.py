@@ -643,6 +643,229 @@ def run_serve(
     return result
 
 
+# --------------------------------------------------------------- fleet mode
+# Availability-under-failure drill on CPU: a 2-replica supervised fleet
+# behind the router (serve/fleet.py + serve/router.py), closed-loop load in
+# two phases — baseline (both replicas healthy) and chaos (one replica
+# SIGKILLed mid-load) — reporting availability (every request must end in a
+# stream-to-completion OR an explicit retryable answer) and the p99 latency
+# delta the failover costs. Runs in a JAX_PLATFORMS=cpu subprocess (the
+# replicas are subprocesses of THAT child); driven by the `perf`+`chaos`-
+# marked pytest (tests/test_serve_bench.py), kept out of tier-1.
+
+
+def _fleet_child(cfg_json: str) -> None:
+    import http.client
+    import threading
+
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+
+    cfg = json.loads(cfg_json)
+    n_requests = cfg["requests"]
+    max_new = cfg["max_new"]
+
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "2",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "64",
+                "--queue-depth", "16",
+            ),
+            max_restarts=1,
+            backoff_s=0.2,
+            drain_timeout_s=15.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, breaker_threshold=3,
+            breaker_cooldown_s=0.5, retry_backoff_s=0.02,
+            retry_backoff_max_s=0.1, ttfb_timeout_s=120.0,
+        ),
+    ).start()
+    assert fleet.wait_ready(timeout=180), fleet.stats()
+    httpd = make_router_http_server(fleet.router)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def one_request(i: int, phase: str) -> dict:
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps({
+                    "prompt": f"{phase} request {i}",
+                    "max_new_tokens": max_new,
+                }),
+                headers={"X-Request-Id": f"{phase}-{i}"},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                conn.close()
+                return {"outcome": "rejected",
+                        "latency_s": time.perf_counter() - t0}
+            lines = resp.read().decode().splitlines()
+            conn.close()
+            last = json.loads(lines[-1]) if lines else {}
+            if last.get("event") == "done":
+                outcome = "done"
+            elif last.get("event") == "error" and last.get("retryable"):
+                outcome = "retryable_error"
+            else:
+                outcome = "bad"
+            return {"outcome": outcome,
+                    "latency_s": time.perf_counter() - t0}
+        except Exception as e:
+            return {"outcome": "exception", "error": repr(e),
+                    "latency_s": time.perf_counter() - t0}
+
+    def run_phase(phase: str, kill_at: int | None) -> dict:
+        results: list = [None] * n_requests
+        started = threading.Semaphore(0)
+        work = list(range(n_requests))
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i = work.pop(0)
+                started.release()
+                results[i] = one_request(i, phase)
+
+        killer = None
+        if kill_at is not None:
+            def kill_mid_load():
+                for _ in range(kill_at):
+                    started.acquire()
+                fleet.replica(0).kill()     # hard mid-load kill
+
+            killer = threading.Thread(target=kill_mid_load, daemon=True)
+            killer.start()
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, daemon=True)
+            for _ in range(cfg["concurrency"])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        lat = sorted(r["latency_s"] for r in results if r is not None)
+
+        def pct(p):
+            import math
+
+            return (
+                lat[min(len(lat) - 1, math.ceil(p / 100 * len(lat)) - 1)]
+                if lat else None
+            )
+
+        outcomes = [r["outcome"] if r else "hang" for r in results]
+        return {
+            "requests": n_requests,
+            "done": outcomes.count("done"),
+            "retryable_errors": outcomes.count("retryable_error"),
+            "rejected": outcomes.count("rejected"),
+            "hung_or_bad": sum(
+                1 for o in outcomes
+                if o in ("bad", "exception", "hang")
+            ),
+            "availability": outcomes.count("done") / n_requests,
+            "explicit_answer_rate": sum(
+                1 for o in outcomes
+                if o in ("done", "retryable_error", "rejected")
+            ) / n_requests,
+            "p50_s": pct(50),
+            "p99_s": pct(99),
+            "wall_s": round(wall, 3),
+        }
+
+    # warm both replicas' compile caches out of the timed phases
+    for i in range(2):
+        one_request(i, "warm")
+
+    baseline = run_phase("base", kill_at=None)
+    # replica 0 dies after a quarter of the chaos-phase requests have
+    # started — early enough that most of the load runs against a
+    # one-replica pool, late enough that requests are provably in flight
+    chaos = run_phase("chaos", kill_at=max(1, n_requests // 4))
+
+    # let the supervisor bring the pool back, then prove it recovered
+    recovered = fleet.wait_ready(timeout=180, min_replicas=2)
+    post = one_request(0, "post")
+
+    stats = fleet.stats()
+    httpd.shutdown()
+    fleet.stop(drain=False)
+
+    result = {
+        "metric": (
+            f"fleet quick bench (tiny LM, CPU, 2 replicas, "
+            f"{n_requests} requests x {max_new} new tokens per phase, "
+            f"replica 0 SIGKILLed mid-chaos-load)"
+        ),
+        "baseline": baseline,
+        "chaos": chaos,
+        "p99_delta": (
+            round(chaos["p99_s"] / baseline["p99_s"], 3)
+            if baseline["p99_s"] and chaos["p99_s"] else None
+        ),
+        "availability": chaos["availability"],
+        "router": {
+            "failovers": stats["router"]["failovers"],
+            "rejected": stats["router"]["rejected"],
+            "hedges": stats["router"]["hedges"],
+        },
+        "recovery": {
+            "pool_recovered": recovered,
+            "post_recovery_request": post["outcome"],
+            "replica0_restarts_used": stats["replicas"][0]["restarts_used"],
+        },
+    }
+    print(json.dumps(result))
+
+
+def run_fleet(
+    requests: int = 16,
+    concurrency: int = 4,
+    max_new: int = 24,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PDT_TPU_FAULT", None)      # the bench kills by pid, not spec
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+    cfg = dict(requests=requests, concurrency=concurrency, max_new=max_new)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--fleet-child", json.dumps(cfg)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet bench failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 # --------------------------------------------------------------- quick mode
 # Input-pipeline A/B on CPU: prefetch-off vs prefetch-on through the REAL
 # Trainer (tiny synthetic task), plus a cold->warm --compile-cache-dir pair,
@@ -839,6 +1062,19 @@ def main(argv=None):
     p.add_argument("--serve-out", default="BENCH_serve.json",
                    help="where --serve writes its JSON")
     p.add_argument("--serve-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet resilience bench on CPU: 2 supervised "
+                        "replicas behind the router, one SIGKILLed "
+                        "mid-load; reports availability + the p99 latency "
+                        "delta vs the healthy baseline (no TPU, no probe)")
+    p.add_argument("--fleet-requests", type=int, default=16,
+                   help="closed-loop requests per phase")
+    p.add_argument("--fleet-concurrency", type=int, default=4,
+                   help="closed-loop client threads")
+    p.add_argument("--fleet-max-new", type=int, default=24)
+    p.add_argument("--fleet-out", default="BENCH_fleet.json",
+                   help="where --fleet writes its JSON")
+    p.add_argument("--fleet-child", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.quick_child:
@@ -847,6 +1083,18 @@ def main(argv=None):
     if args.serve_child:
         _serve_child(args.serve_child)
         return {"serve_child": True}
+    if args.fleet_child:
+        _fleet_child(args.fleet_child)
+        return {"fleet_child": True}
+    if args.fleet:
+        result = run_fleet(
+            requests=args.fleet_requests,
+            concurrency=args.fleet_concurrency,
+            max_new=args.fleet_max_new,
+            out_path=args.fleet_out,
+        )
+        print(json.dumps(result))
+        return result
     if args.serve:
         result = run_serve(
             requests=args.serve_requests,

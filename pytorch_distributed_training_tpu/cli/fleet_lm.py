@@ -1,0 +1,166 @@
+"""Fleet serving entry point: router + N supervised replica processes.
+
+Fronts ``cli/serve_lm.py`` replicas (one subprocess + HTTP port each) with
+the health-checked router (serve/router.py) and the replica supervisor
+(serve/fleet.py). One command turns a checkpoint into a resilient pool:
+
+    python -m pytorch_distributed_training_tpu.cli.fleet_lm \
+        --replicas 2 --router-port 8000 \
+        --model gpt2-medium --checkpoint-dir /ckpts/run1 \
+        --num-slots 8 --metrics-dir /tmp/fleet_metrics
+
+Clients talk to the router exactly as they would to a single replica
+(``POST /generate`` streams JSONL events; ``GET /healthz``/``/stats``) —
+but a crashed replica is retried away (if nothing streamed yet) or
+surfaced as an explicit retryable error (if it died mid-stream), a hung
+replica trips a circuit breaker and recovers through a half-open probe,
+a SIGTERM'd replica drains and exits 75 (respawned with no restart
+burned), and a fully-down pool answers 503 with ``Retry-After`` instead
+of hanging. ``PDT_TPU_FAULT=replica_crash:5@1`` etc. target individual
+replicas for chaos drills (see faults/inject.py).
+
+SIGTERM/SIGINT to THIS process drains the whole fleet: every replica
+stops admitting, finishes in-flight work and exits 75; the router goes
+down last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from pytorch_distributed_training_tpu.cli.generate_lm import add_model_args
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_model_args(p)
+    p.add_argument("--replicas", type=int, default=2,
+                   help="replica subprocess count")
+    p.add_argument("--router-port", type=int, default=8000,
+                   help="router HTTP port (0 picks a free one)")
+    p.add_argument("--num-slots", type=int, default=4)
+    p.add_argument("--prompt-buckets", default="16,32,64,128")
+    p.add_argument("--max-new-tokens-cap", type=int, default=64)
+    p.add_argument("--queue-depth", type=int, default=16)
+    p.add_argument("--deadline-s", type=float, default=0.0)
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="per-replica crash-restart budget (exit 75 drains "
+                        "never burn one)")
+    p.add_argument("--restart-window-s", type=float, default=0.0,
+                   help="sliding restart budget window (0 = lifetime)")
+    p.add_argument("--drain-timeout-s", type=float, default=10.0,
+                   help="per-replica SIGTERM drain deadline")
+    p.add_argument("--hedge-s", type=float, default=0.0,
+                   help="tail-latency hedging: duplicate a request on a "
+                        "second replica when the first byte takes longer "
+                        "than this (0 = off)")
+    p.add_argument("--request-retries", type=int, default=2,
+                   help="max failover attempts on other replicas for "
+                        "not-yet-streamed requests")
+    p.add_argument("--metrics-dir", default=None,
+                   help="fleet/router telemetry JSONL dir; replicas write "
+                        "their own streams under <dir>/replica-<i>")
+    return p
+
+
+def main(argv=None) -> dict:
+    """Run the fleet until SIGTERM/SIGINT; returns the final fleet stats."""
+    args = build_parser().parse_args(argv)
+
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        get_registry,
+    )
+    from pytorch_distributed_training_tpu.utils.logging import log0
+
+    registry = get_registry()
+    sink = None
+    if args.metrics_dir:
+        from pytorch_distributed_training_tpu.telemetry.sink import JsonlSink
+
+        sink = JsonlSink(args.metrics_dir, process_index=0)
+        registry.attach_sink(sink)
+        sink.emit({
+            "record": "fleet_meta",
+            "replicas": args.replicas,
+            "model": args.model,
+            "num_slots": args.num_slots,
+            "max_restarts": args.max_restarts,
+            "hedge_s": args.hedge_s,
+        })
+
+    replica_args = [
+        "--model", args.model,
+        "--num-slots", str(args.num_slots),
+        "--prompt-buckets", args.prompt_buckets,
+        "--max-new-tokens-cap", str(args.max_new_tokens_cap),
+        "--queue-depth", str(args.queue_depth),
+        "--deadline-s", str(args.deadline_s),
+    ]
+    for flag in ("checkpoint_dir", "hf_checkpoint", "vocab", "merges"):
+        value = getattr(args, flag)
+        if value:
+            replica_args += ["--" + flag.replace("_", "-"), value]
+    extra_args = {}
+    if args.metrics_dir:
+        # per-replica streams: a restarted replica appends to its own file
+        extra_args = {
+            i: ("--metrics-dir", f"{args.metrics_dir}/replica-{i}")
+            for i in range(args.replicas)
+        }
+
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=args.replicas,
+            replica_args=tuple(replica_args),
+            replica_extra_args=extra_args,
+            max_restarts=args.max_restarts,
+            restart_window_s=args.restart_window_s,
+            drain_timeout_s=args.drain_timeout_s,
+        ),
+        RouterConfig(
+            hedge_s=args.hedge_s,
+            max_retries=args.request_retries,
+        ),
+        registry=registry,
+    )
+    fleet.start()
+    httpd = make_router_http_server(fleet.router, port=args.router_port)
+    log0(
+        f"fleet router on http://127.0.0.1:{httpd.server_address[1]} "
+        f"({args.replicas} replicas on ports "
+        f"{[r.port for r in fleet.replicas]})"
+    )
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    try:
+        httpd.serve_forever()
+    finally:
+        log0("draining fleet")
+        fleet.stop(drain=True)
+        stats = fleet.stats()
+        if sink is not None:
+            sink.emit({"record": "fleet_summary", **stats})
+            sink.flush(fsync=True)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

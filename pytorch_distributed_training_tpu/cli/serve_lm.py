@@ -55,6 +55,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--http-port", type=int, default=0,
                    help="serve HTTP on 127.0.0.1:<port> (0 = stdin/JSONL "
                         "mode)")
+    p.add_argument("--http-host", default="127.0.0.1",
+                   help="HTTP bind host (fleet replicas stay on localhost)")
+    p.add_argument("--drain-timeout-s", type=float, default=10.0,
+                   help="SIGTERM grace window: stop admitting, finish "
+                        "in-flight requests up to this many seconds, then "
+                        "exit 75 (resumable — a supervisor respawns without "
+                        "counting a crash)")
+    p.add_argument("--stall-timeout-s", type=float, default=10.0,
+                   help="/healthz reports 'unhealthy' when the serve loop's "
+                        "tick heartbeat is older than this (wedged loop "
+                        "detection for routers/LBs)")
     p.add_argument("--metrics-dir", default=None,
                    help="stream serve telemetry (JSONL) under this directory")
     p.add_argument("--guards", default=None,
@@ -125,22 +136,70 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         guards=GuardSet(
             mode=args.guards or guard_mode_from_env(), registry=registry
         ),
+        stall_timeout_s=args.stall_timeout_s,
     ).start()
 
+    preempted = {"signal": None}
     try:
         if args.http_port:
+            import signal as _signal
+            import threading
+            import time as _time
+
             httpd = make_http_server(
-                server, tok, port=args.http_port
+                server, tok, host=args.http_host, port=args.http_port
             )
             log0(
-                f"serving on http://127.0.0.1:{httpd.server_address[1]} "
+                f"serving on http://{args.http_host}:"
+                f"{httpd.server_address[1]} "
                 f"(POST /generate, GET /healthz, GET /stats)"
             )
+
+            # SIGTERM = preemption: the handler only flags (async-signal-
+            # safe); the drain thread does the work while the MAIN thread
+            # keeps accepting connections — /healthz must answer
+            # "draining" (503) for the whole drain window so routers pull
+            # this replica from rotation BEFORE the process dies.
+            drain_requested = threading.Event()
+
+            def _drain() -> None:
+                drain_requested.wait()
+                t0 = _time.monotonic()
+                log0(
+                    f"SIGTERM: draining (finish in-flight, admit nothing, "
+                    f"deadline {args.drain_timeout_s:.1f}s)"
+                )
+                server.close(drain=True, timeout=args.drain_timeout_s)
+                # let in-flight HTTP streams flush their final events
+                deadline = _time.monotonic() + 2.0
+                while (
+                    httpd.active_streams and _time.monotonic() < deadline
+                ):
+                    _time.sleep(0.01)
+                registry.emit({
+                    "record": "preemption",
+                    "scope": "serve",
+                    "drain_s": _time.monotonic() - t0,
+                })
+                httpd.shutdown()
+
+            drainer = threading.Thread(
+                target=_drain, name="serve-drain", daemon=True
+            )
+            drainer.start()
+
+            def _on_term(signum, frame):
+                preempted["signal"] = signum
+                drain_requested.set()
+
+            _signal.signal(_signal.SIGTERM, _on_term)
+
             try:
                 httpd.serve_forever()
             except KeyboardInterrupt:  # pragma: no cover - interactive stop
                 pass
             finally:
+                drain_requested.set()
                 httpd.shutdown()
         else:
             served = serve_stdio(
@@ -155,6 +214,14 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         if sink is not None:
             sink.emit({"record": "serve_summary", **stats})
             sink.flush(fsync=True)
+    if preempted["signal"] is not None:
+        # graceful preemption drain: exit 75 (EX_TEMPFAIL) so a fleet
+        # supervisor respawns this replica without burning a restart
+        from pytorch_distributed_training_tpu.faults.preemption import (
+            Preempted,
+        )
+
+        raise Preempted(preempted["signal"])
     return stats
 
 

@@ -22,6 +22,7 @@ rest of a production failure story:
 """
 
 from pytorch_distributed_training_tpu.faults.inject import (
+    REPLICA_CRASH_EXIT_CODE,
     FaultPlan,
     InjectedCrash,
     corrupt_step_dir,
@@ -44,6 +45,7 @@ from pytorch_distributed_training_tpu.faults.watchdog import (
 __all__ = [
     "FaultPlan",
     "InjectedCrash",
+    "REPLICA_CRASH_EXIT_CODE",
     "corrupt_step_dir",
     "get_plan",
     "set_plan",

@@ -28,9 +28,29 @@ Syntax — comma-separated specs, each ``kind:arg`` with an optional ``@rank``
                          factor — exercises straggler detection without a
                          slow machine.
 
+Serve-scoped kinds (fired from the decode engine's tick loop, counted in
+BUSY ticks — ticks that admitted/decoded work — so idle spinning never
+advances the schedule and the failure lands at a deterministic point of
+the request stream):
+
+- ``replica_crash:3``     hard-kill this replica process (``os._exit``, no
+                          python cleanup — sockets die mid-stream) right
+                          after busy tick 3; the fleet supervisor's crash
+                          path and the router's failover path run for real.
+- ``replica_hang:3:2``    block the serve loop for 2s (default 2) after busy
+                          tick 3 — the engine heartbeat goes stale, /healthz
+                          flips to ``unhealthy``, the router's breaker trips,
+                          and recovery via half-open probe is exercised when
+                          the hang ends.
+- ``replica_slow:3:4x``   from busy tick 3 onward stretch every tick to 4x
+                          its real duration (stays armed, like a genuinely
+                          slow replica) — drives deadline expiry and the
+                          router's load-away-from-slow behavior.
+
 Every spec fires AT MOST ONCE per process (a restarted attempt inside the
-same process does not re-fire), so an injected crash converges to recovery
-instead of crash-looping.
+same process does not re-fire; ``slow_host``/``replica_slow`` stay armed but
+record once), so an injected crash converges to recovery instead of
+crash-looping.
 """
 
 from __future__ import annotations
@@ -45,7 +65,12 @@ from pytorch_distributed_training_tpu.utils.logging import get_logger
 ENV_VAR = "PDT_TPU_FAULT"
 
 _STEP_KINDS = ("crash_at_step", "sigterm_at_step", "hang_at_step")
-_KINDS = _STEP_KINDS + ("corrupt_ckpt", "slow_host")
+_SERVE_KINDS = ("replica_crash", "replica_hang", "replica_slow")
+_KINDS = _STEP_KINDS + ("corrupt_ckpt", "slow_host") + _SERVE_KINDS
+
+#: the exit status of a hard replica kill — anything but 0/75, so the fleet
+#: supervisor counts it as a crash (burning a restart), never as graceful
+REPLICA_CRASH_EXIT_CODE = 23
 
 logger = get_logger(__name__)
 
@@ -81,6 +106,31 @@ def _parse_spec(text: str) -> FaultSpec:
         spec.step = int(arg)
         if spec.step <= 0:
             raise ValueError(f"{kind} needs a positive step, got {arg!r}")
+    elif kind in _SERVE_KINDS:
+        parts = arg.split(":")
+        spec.step = int(parts[0])
+        if spec.step <= 0:
+            raise ValueError(f"{kind} needs a positive tick, got {arg!r}")
+        if kind == "replica_hang":
+            if len(parts) > 2:
+                raise ValueError(f"{kind} takes tick[:seconds], got {arg!r}")
+            spec.factor = float(parts[1]) if len(parts) == 2 else 2.0
+            if spec.factor <= 0:
+                raise ValueError(
+                    f"{kind} needs a positive hang duration, got {arg!r}"
+                )
+        elif kind == "replica_slow":
+            if len(parts) != 2:
+                raise ValueError(f"{kind} needs tick:factor (e.g. 3:4x), "
+                                 f"got {arg!r}")
+            m = re.fullmatch(r"([0-9.]+)x?", parts[1])
+            if not m or float(m.group(1)) < 1.0:
+                raise ValueError(
+                    f"{kind} needs a factor >= 1 (e.g. 4x), got {arg!r}"
+                )
+            spec.factor = float(m.group(1))
+        elif len(parts) != 1:
+            raise ValueError(f"{kind} takes a bare tick, got {arg!r}")
     elif kind == "corrupt_ckpt":
         if arg != "latest" and not arg.isdigit():
             raise ValueError(
@@ -168,6 +218,63 @@ class FaultPlan:
             with watchdog_guard("injected_hang", step=step):
                 while True:  # a stuck collective never returns; nor do we —
                     time.sleep(60)  # the watchdog's hard timeout ends this
+
+    def fire_serve_tick(self, busy_tick: int, elapsed_s: float) -> None:
+        """Decode-engine hook, called after busy tick ``busy_tick`` (a tick
+        that admitted or decoded work) took ``elapsed_s`` seconds."""
+        spec = self._take("replica_crash", lambda s: s.step == busy_tick)
+        if spec is not None:
+            _emit({"fault": "replica_crash", "tick": busy_tick})
+            logger.warning(
+                "injecting replica crash after busy tick %d", busy_tick
+            )
+            self._flush_sink()
+            os._exit(REPLICA_CRASH_EXIT_CODE)  # hard kill: no cleanup,
+            # streams die mid-token — the failure the router must survive
+        spec = self._take("replica_hang", lambda s: s.step == busy_tick)
+        if spec is not None:
+            _emit({
+                "fault": "replica_hang", "tick": busy_tick,
+                "seconds": spec.factor,
+            })
+            logger.warning(
+                "injecting %.1fs serve-loop hang after busy tick %d",
+                spec.factor, busy_tick,
+            )
+            time.sleep(spec.factor)
+            return
+        pidx = _process_index()
+        for spec in self.specs:
+            if (
+                spec.kind == "replica_slow"
+                and spec.rank == pidx
+                and busy_tick >= spec.step
+            ):
+                if not spec.fired:
+                    spec.fired = True  # record the injection once; the
+                    # stretch itself stays armed (a slow replica is slow
+                    # on every tick, not once)
+                    _emit({
+                        "fault": "replica_slow", "tick": busy_tick,
+                        "factor": spec.factor,
+                    })
+                time.sleep(max(0.0, elapsed_s) * (spec.factor - 1.0))
+                return
+
+    @staticmethod
+    def _flush_sink() -> None:
+        """Best-effort telemetry flush before a hard ``os._exit`` (which
+        skips every buffered-writer destructor)."""
+        try:
+            from pytorch_distributed_training_tpu.telemetry.registry import (
+                get_registry,
+            )
+
+            sink = get_registry().sink
+            if sink is not None:
+                sink.flush(fsync=True)
+        except Exception:  # pragma: no cover - dying anyway
+            pass
 
     def slow_host_delay(self, elapsed_s: float) -> None:
         """Loader hook: stretch this host's batch work to ``factor`` × its

@@ -13,7 +13,17 @@ the repo with no way to SERVE a model; this package is that missing half:
                 depth, per-request deadlines, FIFO-within-bucket
                 scheduling;
 - ``server``  — the serve-loop thread plus stdin/JSONL and localhost HTTP
-                front-ends that stream tokens back per request.
+                front-ends that stream tokens back per request; /healthz
+                reports ready/draining/unhealthy with live load for
+                routers and external LBs;
+- ``router``  — health-checked request router over N replicas: circuit
+                breakers with half-open probes, telemetry-driven
+                least-loaded balancing, bounded retries for not-yet-
+                streamed requests, optional tail-latency hedging,
+                fail-fast 503 + Retry-After when the pool is down;
+- ``fleet``   — replica-pool supervision: serve_lm subprocesses under the
+                supervisor restart contract (crash -> backoff respawn
+                within a budget; SIGTERM -> drain, exit 75, respawn free).
 
 Observability and failure handling ride the existing subsystems:
 per-request TTFT/TPOT/queue-wait records and queue-depth/slot-occupancy
@@ -33,6 +43,16 @@ from pytorch_distributed_training_tpu.serve.queue import (
     GenRequest,
     RequestQueue,
 )
+from pytorch_distributed_training_tpu.serve.fleet import (
+    FleetConfig,
+    ServeFleet,
+)
+from pytorch_distributed_training_tpu.serve.router import (
+    CircuitBreaker,
+    Router,
+    RouterConfig,
+    make_router_http_server,
+)
 from pytorch_distributed_training_tpu.serve.server import (
     InferenceServer,
     make_http_server,
@@ -41,11 +61,17 @@ from pytorch_distributed_training_tpu.serve.server import (
 
 __all__ = [
     "BackpressureError",
+    "CircuitBreaker",
     "DecodeEngine",
     "EngineConfig",
+    "FleetConfig",
     "GenRequest",
     "InferenceServer",
     "RequestQueue",
+    "Router",
+    "RouterConfig",
+    "ServeFleet",
     "make_http_server",
+    "make_router_http_server",
     "serve_stdio",
 ]

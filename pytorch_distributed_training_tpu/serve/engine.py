@@ -64,7 +64,11 @@ from pytorch_distributed_training_tpu.analysis.guards import (
     guard_mode_from_env,
 )
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
-from pytorch_distributed_training_tpu.serve.queue import GenRequest, RequestQueue
+from pytorch_distributed_training_tpu.serve.queue import (
+    GenRequest,
+    RequestQueue,
+    emit_expiry,
+)
 from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -203,8 +207,14 @@ class DecodeEngine:
             (config.num_slots, cfg.vocab_size), np.float32
         )
         self.ticks = 0
+        self.busy_ticks = 0         # ticks that admitted/decoded work — the
+        # clock serve-scoped fault injection counts in
         self.admitted = 0
         self.finished = 0
+        # liveness heartbeat: stamped at the end of every tick (including
+        # idle ones — the serve loop re-ticks every idle-wait interval), so
+        # /healthz can tell "loop wedged mid-tick" from "loop idle"
+        self.last_tick_t = time.monotonic()
 
     # -------------------------------------------------------------- compiled
 
@@ -424,7 +434,7 @@ class DecodeEngine:
         worked = False
 
         for req in self._queue.expire_overdue():
-            self._registry.inc("serve/expired")
+            emit_expiry(self._registry, req, "queued")
             self._finish(req, "expired", "deadline")
             worked = True
 
@@ -433,7 +443,7 @@ class DecodeEngine:
         for i, s in enumerate(self._slots):
             if s is not None and s.request.overdue(now):
                 self._slots[i] = None
-                self._registry.inc("serve/expired")
+                emit_expiry(self._registry, s.request, "running")
                 self._finish(s.request, "expired", "deadline")
                 worked = True
 
@@ -488,12 +498,20 @@ class DecodeEngine:
         self._registry.gauge("serve/queue_depth", self._queue.depth())
         self._registry.gauge("serve/slot_occupancy", self.slot_occupancy())
         if worked:
+            self.busy_ticks += 1
             self._registry.observe("serve/tick", time.monotonic() - t0)
-            # deterministic serving-time stretch (PDT_TPU_FAULT=slow_host:Nx)
-            # — the chaos drill for deadline expiry and backpressure
+            # deterministic chaos hooks: slow_host:Nx stretches serving time
+            # (deadline/backpressure drills); the replica_* kinds crash,
+            # hang or slow THIS replica at an exact busy tick (router
+            # failover / breaker / drain drills). Both fire before the
+            # heartbeat stamp below, so an injected hang reads as a stale
+            # heartbeat — exactly like a wedged device would.
             from pytorch_distributed_training_tpu.faults.inject import get_plan
 
-            get_plan().slow_host_delay(time.monotonic() - t0)
+            plan = get_plan()
+            plan.slow_host_delay(time.monotonic() - t0)
+            plan.fire_serve_tick(self.busy_ticks, time.monotonic() - t0)
+        self.last_tick_t = time.monotonic()
         return worked
 
     # -------------------------------------------------------------- shutdown
@@ -518,6 +536,7 @@ class DecodeEngine:
     def stats(self) -> dict:
         return {
             "ticks": self.ticks,
+            "busy_ticks": self.busy_ticks,
             "admitted": self.admitted,
             "finished": self.finished,
             "queue_depth": self._queue.depth(),

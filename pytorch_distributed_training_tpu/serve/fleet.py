@@ -1,0 +1,396 @@
+"""Replica-pool supervision: N serving subprocesses + the router, one unit.
+
+``ServeFleet`` turns ``cli/serve_lm.py`` (one replica = one process = one
+HTTP port) into a supervised pool fronted by ``serve/router.py``. The
+supervision contract is the one PR 2 established for training, extended to
+serving:
+
+- every replica runs under ``utils/supervisor.run_with_restarts``: a crash
+  (any exit but 0/75) burns a restart from the budget and respawns after
+  decorrelated-jitter backoff; an exhausted budget marks the replica
+  ``failed`` and the pool runs degraded;
+- exit 75 (``faults.preemption.RESUMABLE_EXIT_CODE``) is a GRACEFUL drain
+  — the replica advertised ``draining`` on /healthz, finished its in-flight
+  requests and left. The supervisor does NOT count it as a crash: the
+  replica respawns immediately with the restart budget untouched;
+- ``PDT_TPU_FAULT`` serve specs are routed per replica by their ``@rank``
+  suffix (``replica_crash:5@1`` kills replica 1 at busy tick 5, replica 0
+  never sees the spec) — the same one-env-var chaos-drill story as
+  training, now addressing members of a fleet.
+
+Ports are assigned up front (one free port per replica, reused across
+respawns) so the router's endpoint list is static while processes come and
+go behind it. Telemetry: ``replica_spawn`` / ``replica_exit`` /
+``replica_drain`` records in the fleet process's stream, which
+``scripts/summarize_metrics.py`` folds into the fleet section.
+
+This module is jax-free on purpose: the fleet/router process does no
+accelerator work — all the jax lives in the replica subprocesses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from pytorch_distributed_training_tpu.faults.inject import (
+    _SERVE_KINDS,
+)
+from pytorch_distributed_training_tpu.faults.preemption import (
+    RESUMABLE_EXIT_CODE,
+    Preempted,
+)
+from pytorch_distributed_training_tpu.serve.router import (
+    Router,
+    RouterConfig,
+)
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (released immediately; the tiny window
+    before the replica binds it is acceptable for local fleets)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def split_fault_specs(text: Optional[str]) -> dict:
+    """Route a ``PDT_TPU_FAULT`` value to fleet members: serve-scoped specs
+    go to the replica named by their ``@rank`` suffix (stripped — inside
+    its own process every replica is rank 0); everything else is dropped
+    from replica envs (a train-scoped spec must not fire in N serving
+    processes at once). Returns ``{replica_index: "spec,spec"}``."""
+    routed: dict[int, list] = {}
+    if not text or not text.strip():
+        return {}
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        spec, rank = raw, 0
+        if "@" in raw:
+            spec, rank_s = raw.rsplit("@", 1)
+            rank = int(rank_s)
+        if spec.split(":", 1)[0] in _SERVE_KINDS:
+            routed.setdefault(rank, []).append(spec)
+    return {k: ",".join(v) for k, v in routed.items()}
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Pool shape + supervision policy. ``replica_args`` is the serve_lm
+    argv tail shared by every replica (model/engine/queue knobs);
+    ``replica_extra_args`` maps replica index -> extra argv for that
+    replica only (e.g. its own --metrics-dir); ``replica_env`` overlays
+    the inherited environment; ``fault_env`` maps replica index -> a
+    PDT_TPU_FAULT value for that replica only."""
+
+    num_replicas: int = 2
+    replica_args: tuple = ()
+    replica_extra_args: dict = dataclasses.field(default_factory=dict)
+    replica_env: dict = dataclasses.field(default_factory=dict)
+    fault_env: dict = dataclasses.field(default_factory=dict)
+    max_restarts: int = 2
+    restart_window_s: float = 0.0
+    backoff_s: float = 0.25
+    drain_timeout_s: float = 10.0
+    spawn_timeout_s: float = 120.0
+    host: str = "127.0.0.1"
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {self.num_replicas}"
+            )
+
+
+class ReplicaCrashed(RuntimeError):
+    """A replica exited with a non-graceful status (anything but 0/75)."""
+
+    def __init__(self, name: str, returncode: int):
+        super().__init__(f"replica {name} exited rc={returncode}")
+        self.returncode = returncode
+
+
+class ReplicaProcess:
+    """One supervised serving subprocess on a fixed port."""
+
+    def __init__(self, index: int, port: int, fleet_cfg: FleetConfig,
+                 registry):
+        self.index = index
+        self.name = f"r{index}"
+        self.port = port
+        self._cfg = fleet_cfg
+        self._registry = registry
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "starting"     # starting|up|failed|stopped
+        self.restarts_used = 0
+        self.graceful_exits = 0
+        self.spawns = 0
+        self._stopping = threading.Event()
+        self._sigterm_t: Optional[float] = None
+        self._thread = threading.Thread(
+            target=self._monitor, name=f"fleet-{self.name}", daemon=True
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ReplicaProcess":
+        self._thread.start()
+        return self
+
+    def _argv(self) -> list:
+        return [
+            sys.executable, "-m",
+            "pytorch_distributed_training_tpu.cli.serve_lm",
+            "--http-port", str(self.port),
+            "--http-host", self._cfg.host,
+            "--drain-timeout-s", str(self._cfg.drain_timeout_s),
+            *self._cfg.replica_args,
+            *self._cfg.replica_extra_args.get(self.index, ()),
+        ]
+
+    def _env(self) -> dict:
+        env = dict(os.environ)
+        env.update(self._cfg.replica_env)
+        # fault routing: only THIS replica's serve-scoped specs survive
+        env.pop("PDT_TPU_FAULT", None)
+        fault = self._cfg.fault_env.get(self.index)
+        if fault:
+            env["PDT_TPU_FAULT"] = fault
+        return env
+
+    def _spawn_and_wait(self, attempt: int) -> None:
+        """One supervised attempt: spawn, record, wait, classify the exit."""
+        self.spawns += 1
+        proc = subprocess.Popen(
+            self._argv(), env=self._env(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        self.proc = proc
+        self.state = "up"
+        logger.info(
+            "replica %s spawned pid=%d port=%d attempt=%d",
+            self.name, proc.pid, self.port, attempt,
+        )
+        self._registry.emit({
+            "record": "replica_spawn",
+            "replica": self.name,
+            "pid": proc.pid,
+            "port": self.port,
+            "attempt": attempt,
+        })
+        rc = proc.wait()
+        graceful = rc == RESUMABLE_EXIT_CODE
+        drain_s = (
+            time.monotonic() - self._sigterm_t
+            if graceful and self._sigterm_t is not None
+            else None
+        )
+        self._sigterm_t = None
+        self._registry.emit({
+            "record": "replica_exit",
+            "replica": self.name,
+            "rc": rc,
+            "graceful": graceful,
+            **({"drain_s": drain_s} if drain_s is not None else {}),
+        })
+        if graceful:
+            self.graceful_exits += 1
+            if drain_s is not None:
+                self._registry.emit({
+                    "record": "replica_drain",
+                    "replica": self.name,
+                    "drain_s": drain_s,
+                })
+            raise Preempted(signal.SIGTERM)
+        if rc != 0 and not self._stopping.is_set():
+            self._registry.inc("fleet/replica_crashes")
+            raise ReplicaCrashed(self.name, rc)
+
+    def _monitor(self) -> None:
+        """Supervision loop: ``run_with_restarts`` handles the crash path
+        (budget + decorrelated-jitter backoff); a graceful exit-75 drain
+        propagates as ``Preempted`` WITHOUT burning a restart, and the
+        replica respawns immediately — a preempted replica is capacity to
+        restore, not a failure to count."""
+        from pytorch_distributed_training_tpu.utils.supervisor import (
+            run_with_restarts,
+        )
+
+        while not self._stopping.is_set():
+            try:
+                run_with_restarts(
+                    self._attempt,
+                    max_restarts=self._cfg.max_restarts,
+                    backoff_s=self._cfg.backoff_s,
+                    restart_window_s=self._cfg.restart_window_s,
+                    max_backoff_s=max(self._cfg.backoff_s * 4, 1.0),
+                )
+                self.state = "stopped"
+                return
+            except Preempted:
+                if self._stopping.is_set():
+                    self.state = "stopped"
+                    return
+                logger.info(
+                    "replica %s drained gracefully; respawning without "
+                    "burning a restart", self.name,
+                )
+                continue
+            except ReplicaCrashed:
+                logger.error(
+                    "replica %s exhausted its restart budget; pool runs "
+                    "degraded", self.name,
+                )
+                self.state = "failed"
+                self._registry.emit({
+                    "record": "replica_failed",
+                    "replica": self.name,
+                    "restarts_used": self.restarts_used,
+                })
+                return
+
+    def _attempt(self, i: int) -> None:
+        if i > 0:
+            self.restarts_used += 1
+        if self._stopping.is_set():
+            return
+        self._spawn_and_wait(i)
+
+    # -------------------------------------------------------------- control
+
+    def sigterm(self) -> None:
+        """Graceful drain request (the preemption signal)."""
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            self._sigterm_t = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def stop(self, *, drain: bool = True) -> None:
+        """Terminate and stop respawning. ``drain=True`` sends SIGTERM and
+        allows the drain window; ``drain=False`` kills immediately."""
+        self._stopping.set()
+        if drain:
+            self.sigterm()
+        else:
+            self.kill()
+
+    def join(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        self._thread.join(timeout)
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                logger.error(
+                    "replica %s did not exit within the drain window; "
+                    "killing", self.name,
+                )
+                proc.kill()
+                proc.wait(5.0)
+
+    def describe(self) -> dict:
+        proc = self.proc
+        return {
+            "replica": self.name,
+            "port": self.port,
+            "state": self.state,
+            "pid": proc.pid if proc is not None else None,
+            "alive": proc is not None and proc.poll() is None,
+            "spawns": self.spawns,
+            "restarts_used": self.restarts_used,
+            "graceful_exits": self.graceful_exits,
+        }
+
+
+class ServeFleet:
+    """N supervised replicas + one router, started and stopped together."""
+
+    def __init__(
+        self,
+        fleet_config: FleetConfig,
+        router_config: Optional[RouterConfig] = None,
+        *,
+        registry=None,
+    ):
+        if registry is None:
+            from pytorch_distributed_training_tpu.telemetry.registry import (
+                get_registry,
+            )
+
+            registry = get_registry()
+        self._registry = registry
+        self.config = fleet_config
+        if not fleet_config.fault_env:
+            fleet_config.fault_env = split_fault_specs(
+                os.environ.get("PDT_TPU_FAULT")
+            )
+        self.replicas = [
+            ReplicaProcess(
+                i, find_free_port(fleet_config.host), fleet_config, registry
+            )
+            for i in range(fleet_config.num_replicas)
+        ]
+        self.router = Router(
+            [(r.name, fleet_config.host, r.port) for r in self.replicas],
+            router_config,
+            registry=registry,
+        )
+
+    def start(self) -> "ServeFleet":
+        for replica in self.replicas:
+            replica.start()
+        self.router.start()
+        return self
+
+    def wait_ready(self, timeout: Optional[float] = None,
+                   min_replicas: Optional[int] = None) -> bool:
+        """Block until ``min_replicas`` (default: all) replicas are in
+        rotation — replica boot includes a jax import and model init, so
+        first readiness takes seconds even for a tiny model."""
+        timeout = self.config.spawn_timeout_s if timeout is None else timeout
+        want = (
+            len(self.replicas) if min_replicas is None else min_replicas
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.router.available_count() >= want:
+                return True
+            time.sleep(0.05)
+        return self.router.available_count() >= want
+
+    def replica(self, index: int) -> ReplicaProcess:
+        return self.replicas[index]
+
+    def stop(self, *, drain: bool = True) -> None:
+        """Drain (or kill) every replica, stop respawns, stop the router."""
+        for replica in self.replicas:
+            replica.stop(drain=drain)
+        join_s = self.config.drain_timeout_s + 10.0 if drain else 10.0
+        for replica in self.replicas:
+            replica.join(join_s)
+        self.router.close()
+
+    def stats(self) -> dict:
+        return {
+            "replicas": [r.describe() for r in self.replicas],
+            "router": self.router.stats(),
+        }

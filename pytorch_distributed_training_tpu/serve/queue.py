@@ -34,6 +34,28 @@ class BackpressureError(RuntimeError):
     """The queue is at ``max_depth`` — resubmit later (HTTP front-end: 429)."""
 
 
+def emit_expiry(registry, request: "GenRequest", phase: str) -> None:
+    """Record one deadline expiry, split by WHERE the request died: a spike
+    of ``queued`` expiries means overload (admission never came), a spike of
+    ``running`` expiries means a stuck/slow replica (decode fell behind its
+    deadline) — fleet dashboards need the two separated to pick between
+    scale-out and drain-and-replace. Counters ``serve/expired_queued`` /
+    ``serve/expired_running`` (plus the pre-existing ``serve/expired``
+    total) and a per-request ``serve_expired`` record."""
+    assert phase in ("queued", "running"), phase
+    registry.inc("serve/expired")
+    registry.inc(f"serve/expired_{phase}")
+    registry.emit({
+        "record": "serve_expired",
+        "id": request.id,
+        "phase": phase,
+        "bucket": request.bucket,
+        "deadline_s": request.deadline_s,
+        "waited_s": time.monotonic() - request.submit_t,
+        "new_tokens": len(request.tokens),
+    })
+
+
 @dataclasses.dataclass
 class GenRequest:
     """One generation request plus its runtime bookkeeping.

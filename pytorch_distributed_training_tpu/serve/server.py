@@ -11,9 +11,14 @@ only touches the queue). Two transports ship with it:
   batching made visible).
 - ``make_http_server``: a localhost ``ThreadingHTTPServer``. ``POST
   /generate`` streams the same JSONL event lines over a close-delimited
-  HTTP/1.0 response; queue-full maps to 429 (backpressure is an answer,
-  not a hang). ``GET /healthz`` and ``GET /stats`` expose liveness and
-  queue-depth/slot-occupancy for load balancers and dashboards.
+  HTTP/1.0 response; queue-full maps to 429 + ``Retry-After``
+  (backpressure is an answer, not a hang) and every response carries the
+  request's ``X-Request-Id`` (accepted or generated — the join key from
+  router to telemetry). ``GET /healthz`` answers the three-state health
+  contract routers act on: 200 ``ready`` with queue-depth/slot-occupancy
+  load, 503 ``draining`` while a shutdown finishes in-flight work, 503
+  ``unhealthy`` when the serve loop died or its tick heartbeat went
+  stale (``stall_timeout_s``). ``GET /stats`` exposes engine counters.
 
 Shutdown: ``close(drain=True)`` stops admissions and runs the engine until
 in-flight work completes; ``close(drain=False)`` cancels everything
@@ -27,6 +32,7 @@ import itertools
 import json
 import threading
 import time
+import uuid
 from typing import Optional
 
 import numpy as np
@@ -60,6 +66,7 @@ class InferenceServer:
         default_deadline_s: Optional[float] = None,
         registry=None,
         guards=None,
+        stall_timeout_s: float = 10.0,
     ):
         self.queue = RequestQueue(
             max_depth=queue_depth,
@@ -71,10 +78,13 @@ class InferenceServer:
             guards=guards,
         )
         self.default_deadline_s = default_deadline_s
+        self.stall_timeout_s = stall_timeout_s
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._draining = False
+        self._drain_requested = False
+        self._loop_failed = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -104,6 +114,7 @@ class InferenceServer:
             logger.exception(
                 "serve loop died; cancelling all in-flight requests"
             )
+            self._loop_failed = True    # /healthz: unhealthy, not draining
             self.queue.close()
             try:
                 self.engine.cancel_all()
@@ -112,7 +123,12 @@ class InferenceServer:
 
     def close(self, *, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop serving. ``drain=True`` finishes in-flight and queued work
-        first; ``drain=False`` cancels it. Idempotent."""
+        first; ``drain=False`` cancels it. Idempotent.
+
+        The draining state is visible on ``health()`` from the first line —
+        a router polling ``/healthz`` pulls the replica out of rotation
+        while the drain is still finishing in-flight work, not after."""
+        self._drain_requested = True
         self.queue.close()
         self._draining = drain
         self._stop.set()
@@ -168,6 +184,44 @@ class InferenceServer:
 
     def stats(self) -> dict:
         return self.engine.stats()
+
+    # ---------------------------------------------------------------- health
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_requested or self.queue.closed
+
+    def health(self) -> dict:
+        """Liveness + load for routers and external LBs: ``state`` is
+        ``ready`` / ``draining`` (shutdown in progress — in-flight work is
+        finishing, nothing new is admitted) / ``unhealthy`` (serve loop
+        died, or its tick heartbeat is older than ``stall_timeout_s`` —
+        a wedged device or hung loop that a liveness-only check would
+        miss, because the HTTP threads answering /healthz are NOT the
+        thread doing the decoding)."""
+        thread = self._thread
+        if self._loop_failed:
+            state = "unhealthy"
+        elif self.draining:
+            state = "draining"
+        elif thread is not None and not thread.is_alive():
+            state = "unhealthy"     # loop exited without close()
+        elif (
+            thread is not None
+            and time.monotonic() - self.engine.last_tick_t
+            > self.stall_timeout_s
+        ):
+            state = "unhealthy"     # heartbeat stale: loop wedged mid-tick
+        else:
+            state = "ready"
+        return {
+            "state": state,
+            "draining": self.draining,
+            "queue_depth": self.queue.depth(),
+            "slot_occupancy": self.engine.slot_occupancy(),
+            "num_slots": self.engine.config.num_slots,
+            "queue_capacity": self.queue.max_depth,
+        }
 
 
 # ------------------------------------------------------------------- stdio
@@ -274,11 +328,25 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
 # -------------------------------------------------------------------- http
 
 
+#: Retry-After seconds advertised on 429 (queue full — drains in request
+#: time) and on 503 while draining (a replacement replica needs to boot).
+BACKPRESSURE_RETRY_AFTER_S = 1
+DRAINING_RETRY_AFTER_S = 5
+
+
 def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                      port: int = 0):
     """A localhost ``ThreadingHTTPServer`` bound to ``(host, port)`` (port 0
     picks a free one; read it back from ``.server_address``). The caller
-    runs ``serve_forever`` (blocking) or a thread around it."""
+    runs ``serve_forever`` (blocking) or a thread around it.
+
+    Every response carries ``X-Request-Id`` (the caller's header, else the
+    body ``id``, else generated) and the id rides the request through
+    queue → engine → telemetry, so one request is one join key across the
+    router's, the replica's and the client's views of it. The returned
+    httpd object exposes ``active_streams`` — the number of /generate
+    responses still streaming — which the drain path waits on before
+    tearing the process down."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     eot_id = getattr(tokenizer, "eot_id", None)
@@ -290,17 +358,27 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
         def log_message(self, fmt, *args):  # route through framework logging
             logger.debug("http: " + fmt, *args)
 
-        def _json(self, code: int, obj: dict) -> None:
+        def _json(self, code: int, obj: dict, headers: dict = None) -> None:
             body = (json.dumps(obj) + "\n").encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True})
+                h = server.health()
+                if h["state"] == "ready":
+                    self._json(200, h)
+                else:
+                    # 503, not 200-with-a-sad-body: routers and external
+                    # LBs act on status codes, not on parsed payloads
+                    self._json(503, h, headers={
+                        "Retry-After": DRAINING_RETRY_AFTER_S,
+                    })
             elif self.path == "/stats":
                 self._json(200, server.stats())
             else:
@@ -310,20 +388,32 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
             if self.path != "/generate":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
+            rid = self.headers.get("X-Request-Id")
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 msg = json.loads(self.rfile.read(n) or b"{}")
+                rid = rid or msg.get("id") or uuid.uuid4().hex[:12]
                 prompt = msg["prompt"]
                 if not isinstance(prompt, str):
                     raise TypeError(
                         f"prompt must be a string, got {type(prompt).__name__}"
                     )
             except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
-                self._json(400, {"error": f"bad request: {e}"})
+                self._json(400, {"error": f"bad request: {e}", "id": rid},
+                           headers={"X-Request-Id": rid} if rid else None)
+                return
+            if server.draining:
+                self._json(503, {
+                    "error": "replica draining", "state": "draining",
+                    "id": rid,
+                }, headers={"Retry-After": DRAINING_RETRY_AFTER_S,
+                            "X-Request-Id": rid})
                 return
             ids = tokenizer.text_ids(prompt)
             if not ids:
-                self._json(400, {"error": "empty prompt after tokenization"})
+                self._json(400, {"error": "empty prompt after tokenization",
+                                 "id": rid},
+                           headers={"X-Request-Id": rid})
                 return
 
             import queue as _q
@@ -334,6 +424,7 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 if eot_id is not None and token == eot_id:
                     return
                 events.put({
+                    "id": req.id,
                     "event": "token",
                     "token_id": token,
                     "text": tokenizer.decode([token]),
@@ -341,6 +432,7 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
 
             def on_finish(req):
                 events.put({
+                    "id": req.id,
                     "event": "done",
                     "status": req.status,
                     "finish_reason": req.finish_reason,
@@ -363,25 +455,47 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     deadline_s=msg.get("deadline_s"),
                     stream=on_token,
                     on_finish=on_finish,
-                    request_id=msg.get("id"),
+                    request_id=rid,
                 )
             except BackpressureError as e:
-                self._json(429, {"error": str(e)})
+                # backpressure is retryable BY CONSTRUCTION — say when
+                self._json(429, {"error": str(e), "id": rid},
+                           headers={"Retry-After": BACKPRESSURE_RETRY_AFTER_S,
+                                    "X-Request-Id": rid})
                 return
-            except (ValueError, RuntimeError) as e:
-                self._json(400, {"error": f"{type(e).__name__}: {e}"})
+            except RuntimeError as e:
+                # submit raced the queue closing: draining, not client error
+                self._json(503, {"error": f"{type(e).__name__}: {e}",
+                                 "id": rid},
+                           headers={"Retry-After": DRAINING_RETRY_AFTER_S,
+                                    "X-Request-Id": rid})
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/jsonl")
-            self.end_headers()
-            while True:
-                ev = events.get()
-                if ev is None:
-                    break
-                self.wfile.write((json.dumps(ev) + "\n").encode())
-                self.wfile.flush()
+            except ValueError as e:
+                self._json(400, {"error": f"{type(e).__name__}: {e}",
+                                 "id": rid},
+                           headers={"X-Request-Id": rid})
+                return
+            with self.server.streams_lock:
+                self.server.active_streams += 1
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("X-Request-Id", rid)
+                self.end_headers()
+                while True:
+                    ev = events.get()
+                    if ev is None:
+                        break
+                    self.wfile.write((json.dumps(ev) + "\n").encode())
+                    self.wfile.flush()
+            finally:
+                with self.server.streams_lock:
+                    self.server.active_streams -= 1
 
-    return ThreadingHTTPServer((host, port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.active_streams = 0
+    httpd.streams_lock = threading.Lock()
+    return httpd
 
 
 def wait_until(predicate, timeout: float, poll_s: float = 0.005) -> bool:

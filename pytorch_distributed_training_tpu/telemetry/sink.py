@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Any
 
@@ -59,6 +60,10 @@ class JsonlSink:
     ):
         pidx = jax.process_index() if process_index is None else process_index
         self._file = None
+        # serving emits from many threads at once (router request handlers,
+        # the health loop, fleet monitors); a lock keeps each JSONL line
+        # atomic — interleaved torn lines would poison the whole stream
+        self._lock = threading.Lock()
         self.path = os.path.join(os.path.abspath(metrics_dir), filename)
         if pidx == 0:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -73,23 +78,29 @@ class JsonlSink:
             return
         rec = dict(record)
         rec.setdefault("ts", time.time())
-        self._file.write(json.dumps(_jsonable(rec)) + "\n")
-        self._file.flush()
+        line = json.dumps(_jsonable(rec)) + "\n"
+        with self._lock:
+            if self._file is None:      # closed while we serialized
+                return
+            self._file.write(line)
+            self._file.flush()
 
     def flush(self, *, fsync: bool = False) -> None:
         """Push buffered records to the OS — and with ``fsync``, to disk.
         The crash/preemption/watchdog exits call this so the last records
         (the ones explaining the exit) survive the process."""
-        if self._file is None:
-            return
-        self._file.flush()
-        if fsync:
-            os.fsync(self._file.fileno())
+        with self._lock:
+            if self._file is None:
+                return
+            self._file.flush()
+            if fsync:
+                os.fsync(self._file.fileno())
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
 
 
 def run_metadata(mesh, model_config=None, train_config=None, **extra) -> dict:

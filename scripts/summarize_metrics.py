@@ -110,6 +110,7 @@ def summarize(records: list[dict]) -> dict:
         "checkpoint_saves": len(saves),
         "restarts": len(restarts),
         "serve": summarize_serve(records),
+        "fleet": summarize_fleet(records),
         "guards": guards,
     }
 
@@ -214,6 +215,62 @@ def summarize_serve(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_fleet(records: list[dict]) -> dict | None:
+    """Fold router/fleet records (serve/router.py + serve/fleet.py) into
+    the fleet-health view: per-replica routed-request counts, failovers,
+    hedges, breaker transitions, crash-vs-graceful exits and drain
+    durations. None when the stream holds no fleet records."""
+    router_reqs = [r for r in records if r.get("record") == "router_request"]
+    failovers = [r for r in records if r.get("record") == "router_failover"]
+    hedges = [r for r in records if r.get("record") == "router_hedge"]
+    breakers = [r for r in records if r.get("record") == "router_breaker"]
+    spawns = [r for r in records if r.get("record") == "replica_spawn"]
+    exits = [r for r in records if r.get("record") == "replica_exit"]
+    drains = [r for r in records if r.get("record") == "replica_drain"]
+    if not (router_reqs or spawns or breakers):
+        return None
+
+    replicas: dict[str, dict] = {}
+
+    def rep(name) -> dict:
+        return replicas.setdefault(name or "?", {
+            "requests": 0, "ok": 0, "midstream_errors": 0,
+            "spawns": 0, "crashes": 0, "graceful_exits": 0,
+            "breaker_opens": 0,
+        })
+
+    for r in router_reqs:
+        row = rep(r.get("replica"))
+        row["requests"] += 1
+        if r.get("status") == "ok":
+            row["ok"] += 1
+        elif r.get("status") == "error_midstream":
+            row["midstream_errors"] += 1
+    for r in spawns:
+        rep(r.get("replica"))["spawns"] += 1
+    for r in exits:
+        key = "graceful_exits" if r.get("graceful") else "crashes"
+        rep(r.get("replica"))[key] += 1
+    for r in breakers:
+        if r.get("to") == "open":
+            rep(r.get("replica"))["breaker_opens"] += 1
+    replicas.pop("?", None)     # rejected requests have no replica
+
+    statuses = [r.get("status") for r in router_reqs]
+    return {
+        "routed": len(router_reqs),
+        "ok": statuses.count("ok"),
+        "rejected": statuses.count("rejected"),
+        "midstream_errors": statuses.count("error_midstream"),
+        "failovers": len(failovers),
+        "hedges": len(hedges),
+        "breaker_transitions": len(breakers),
+        "total_s": _pcts([r.get("total_s") for r in router_reqs]),
+        "drain_s": _pcts([r.get("drain_s") for r in drains]),
+        "replicas": {k: replicas[k] for k in sorted(replicas)},
+    }
+
+
 def _fmt(v, spec=".4g") -> str:
     if v is None:
         return "-"
@@ -253,6 +310,41 @@ def render_serve_table(serve: dict) -> str:
         f"expired={serve['expired']} cancelled={serve['cancelled']} "
         f"tokens/s={_fmt(serve.get('tokens_per_s'))} "
         f"queue-wait p95={_fmt(ms(qw, 'p95') if qw else None)}ms"
+    )
+    return "\n".join(lines)
+
+
+def render_fleet_table(fleet: dict) -> str:
+    """Per-replica fleet rows + a resilience footer."""
+    cols = ["replica", "routed", "ok", "midstream", "spawns", "crashes",
+            "drains", "brk-opens"]
+    rows = []
+    for name in sorted(fleet["replicas"]):
+        r = fleet["replicas"][name]
+        rows.append([
+            name, _fmt(r["requests"]), _fmt(r["ok"]),
+            _fmt(r["midstream_errors"]), _fmt(r["spawns"]),
+            _fmt(r["crashes"]), _fmt(r["graceful_exits"]),
+            _fmt(r["breaker_opens"]),
+        ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "fleet:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    drain = fleet.get("drain_s") or {}
+    lines.append(
+        f"routed={fleet['routed']} ok={fleet['ok']} "
+        f"rejected={fleet['rejected']} "
+        f"midstream-errors={fleet['midstream_errors']} "
+        f"failovers={fleet['failovers']} hedges={fleet['hedges']} "
+        f"breaker-transitions={fleet['breaker_transitions']} "
+        f"drain p95={_fmt(drain.get('p95'))}s"
     )
     return "\n".join(lines)
 
@@ -297,11 +389,16 @@ def render_table(summary: dict) -> str:
             f"cache={'hit' if hit else 'miss' if hit is not None else 'off'})"
         )
     serve = summary.get("serve")
+    fleet = summary.get("fleet")
     if serve:
         if summary["epochs"]:
             lines.append(render_serve_table(serve))
         else:  # pure serving stream: the serve table IS the output
             lines = [render_serve_table(serve)]
+    if fleet:
+        if not summary["epochs"] and not serve:
+            lines = []  # pure fleet stream: the fleet table IS the output
+        lines.append(render_fleet_table(fleet))
     guards = summary.get("guards")
     if guards:
         bad = (

@@ -50,3 +50,37 @@ def test_serve_bench_beats_sequential(tmp_path):
         stats = eng[block]
         assert stats["count"] > 0
         assert stats["p50"] <= stats["p95"] <= stats["p99"]
+
+
+@pytest.mark.chaos
+def test_fleet_bench_availability_under_replica_kill(tmp_path):
+    """bench.py --fleet: 2 supervised replicas behind the router, one
+    SIGKILLed mid-load. Every request must end explicitly (done /
+    retryable error / rejected — zero hangs), the supervisor must burn a
+    restart respawning the victim, and the artifact must carry the
+    availability + p99-delta numbers the fleet dashboards track."""
+    out = tmp_path / "BENCH_fleet.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--fleet", "--fleet-out", str(out),
+        ],
+        capture_output=True, text=True, timeout=1200, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(out.read_text())
+
+    base, chaos = result["baseline"], result["chaos"]
+    # healthy pool: everything completes
+    assert base["availability"] == 1.0 and base["hung_or_bad"] == 0
+    # chaos: zero hung waiters — every answer is explicit — and the
+    # surviving replica keeps the pool mostly available
+    assert chaos["hung_or_bad"] == 0, result
+    assert chaos["explicit_answer_rate"] == 1.0
+    assert chaos["availability"] >= 0.5, result
+    # the kill really happened and supervision recovered from it
+    assert result["recovery"]["replica0_restarts_used"] >= 1
+    assert result["recovery"]["pool_recovered"] is True
+    assert result["recovery"]["post_recovery_request"] == "done"
+    # latency artifact present for the dashboard delta
+    assert base["p99_s"] and chaos["p99_s"] and result["p99_delta"]
