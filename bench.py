@@ -866,6 +866,277 @@ def run_fleet(
     return result
 
 
+# ---------------------------------------------------------------- swap mode
+# Latency-under-rollout drill on CPU: a 2-replica fleet serves a closed
+# loop while a NEW checkpoint step is published mid-load and rolled across
+# the pool one replica at a time (serve/hotswap.py). Reports the p99 delta
+# the rollout window costs vs the healthy baseline, the publish->converged
+# time (both replicas and the router's skew view on the new step), and
+# that zero requests failed. Runs in a JAX_PLATFORMS=cpu subprocess;
+# driven by the `perf`+`swap`-marked pytest, kept out of tier-1 timing.
+
+
+def _swap_child(cfg_json: str) -> None:
+    import http.client
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.hotswap import (
+        publish_params_checkpoint,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = json.loads(cfg_json)
+    n_requests = cfg["requests"]
+    max_new = cfg["max_new"]
+
+    mcfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(mcfg)
+
+    def params_for(seed: int):
+        return model.init(
+            jax.random.key(seed), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+
+    import tempfile
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_swap_ckpt_")
+    publish_params_checkpoint(ckpt_dir, 1, params_for(0))
+    # the step-2 weights are built BEFORE any timed phase: the publisher
+    # thread must only write bytes mid-load, not trace/compile a model
+    # init while the client threads fight it for the GIL
+    params_v2 = params_for(7)
+
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "2",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "64",
+                "--queue-depth", "16", "--checkpoint-dir", ckpt_dir,
+            ),
+            max_restarts=1,
+            backoff_s=0.2,
+            drain_timeout_s=15.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, breaker_threshold=3,
+            breaker_cooldown_s=0.5, retry_backoff_s=0.02,
+            retry_backoff_max_s=0.1, ttfb_timeout_s=120.0,
+        ),
+    ).start()
+    assert fleet.wait_ready(timeout=180), fleet.stats()
+    fleet.enable_hotswap(ckpt_dir, poll_interval_s=0.1)
+    httpd = make_router_http_server(fleet.router)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def one_request(i: int, phase: str) -> dict:
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps({
+                    "prompt": f"{phase} request {i}",
+                    "max_new_tokens": max_new,
+                }),
+                headers={"X-Request-Id": f"{phase}-{i}"},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                conn.close()
+                return {"outcome": "rejected",
+                        "latency_s": time.perf_counter() - t0}
+            lines = resp.read().decode().splitlines()
+            conn.close()
+            last = json.loads(lines[-1]) if lines else {}
+            outcome = "done" if last.get("event") == "done" else "bad"
+            return {"outcome": outcome,
+                    "latency_s": time.perf_counter() - t0}
+        except Exception as e:
+            return {"outcome": "exception", "error": repr(e),
+                    "latency_s": time.perf_counter() - t0}
+
+    def run_phase(phase: str, publish_at: int | None) -> dict:
+        results: list = [None] * n_requests
+        started = threading.Semaphore(0)
+        work = list(range(n_requests))
+        lock = threading.Lock()
+        publish_t = [None]
+
+        def client():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i = work.pop(0)
+                started.release()
+                results[i] = one_request(i, phase)
+
+        publisher = None
+        if publish_at is not None:
+            def publish_mid_load():
+                for _ in range(publish_at):
+                    started.acquire()
+                publish_params_checkpoint(ckpt_dir, 2, params_v2)
+                publish_t[0] = time.perf_counter()
+
+            publisher = threading.Thread(target=publish_mid_load,
+                                         daemon=True)
+            publisher.start()
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, daemon=True)
+            for _ in range(cfg["concurrency"])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        if publisher is not None:
+            # the closed loop can finish before the publish lands (CPU
+            # requests are fast); the convergence clock still needs the
+            # real publish timestamp
+            publisher.join(120)
+        lat = sorted(r["latency_s"] for r in results if r is not None)
+
+        def pct(p):
+            import math
+
+            return (
+                lat[min(len(lat) - 1, math.ceil(p / 100 * len(lat)) - 1)]
+                if lat else None
+            )
+
+        outcomes = [r["outcome"] if r else "hang" for r in results]
+        return {
+            "requests": n_requests,
+            "done": outcomes.count("done"),
+            "failed": sum(
+                1 for o in outcomes if o not in ("done", "rejected")
+            ),
+            "rejected": outcomes.count("rejected"),
+            "p50_s": pct(50),
+            "p99_s": pct(99),
+            "wall_s": round(wall, 3),
+            "publish_t": publish_t[0],
+        }
+
+    # warm both replicas' compile caches out of the timed phases (two
+    # rounds: the second lands on warm programs on BOTH replicas, so the
+    # baseline phase measures steady state, not residual compiles)
+    for i in range(4):
+        one_request(i, "warm")
+
+    # baseline runs twice and the p99 denominator averages the passes:
+    # p99 over 16 requests IS the worst sample, so a single pass is one
+    # host hiccup away from either masking or inventing rollout cost
+    base_passes = [run_phase(f"base{i}", publish_at=None) for i in range(2)]
+    baseline = dict(base_passes[0])
+    baseline["p99_s"] = sum(p["p99_s"] for p in base_passes) / 2
+    baseline["p50_s"] = sum(p["p50_s"] for p in base_passes) / 2
+    baseline["done"] = min(p["done"] for p in base_passes)
+    baseline["failed"] = sum(p["failed"] for p in base_passes)
+    # step 2 publishes after a quarter of the swap-phase requests started:
+    # the rollout window overlaps the measured load
+    swap = run_phase("swap", publish_at=max(1, n_requests // 4))
+
+    # convergence: both replicas serving step 2 AND the router's skew is 0
+    def converged() -> bool:
+        stats = fleet.router.stats()
+        return (
+            all(v == 2 for v in stats["weights"].values())
+            and stats["version_skew"] == 0
+        )
+
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline and not converged():
+        time.sleep(0.05)
+    convergence_s = (
+        time.perf_counter() - swap["publish_t"]
+        if swap["publish_t"] is not None and converged() else None
+    )
+    post = one_request(0, "post")
+
+    stats = fleet.stats()
+    httpd.shutdown()
+    fleet.stop(drain=False)
+
+    result = {
+        "metric": (
+            f"hot-swap quick bench (tiny LM, CPU, 2 replicas, "
+            f"{n_requests} requests x {max_new} new tokens per phase, "
+            f"checkpoint step 2 published + rolled out mid-swap-load)"
+        ),
+        "baseline": {k: v for k, v in baseline.items() if k != "publish_t"},
+        "swap": {k: v for k, v in swap.items() if k != "publish_t"},
+        "p99_delta": (
+            round(swap["p99_s"] / baseline["p99_s"], 3)
+            if baseline["p99_s"] and swap["p99_s"] else None
+        ),
+        "failed_requests": baseline["failed"] + swap["failed"],
+        "convergence_s": (
+            round(convergence_s, 3) if convergence_s is not None else None
+        ),
+        "converged": converged(),
+        "post_rollout_request": post["outcome"],
+        "weights": stats["router"]["weights"],
+        "version_skew": stats["router"]["version_skew"],
+        "hotswap": stats.get("hotswap"),
+        "replica_restarts": [
+            r["restarts_used"] for r in stats["replicas"]
+        ],
+    }
+    print(json.dumps(result))
+
+
+def run_swap(
+    requests: int = 16,
+    concurrency: int = 4,
+    max_new: int = 48,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PDT_TPU_FAULT", None)      # the bench publishes real steps
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+    cfg = dict(requests=requests, concurrency=concurrency, max_new=max_new)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--swap-child", json.dumps(cfg)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"swap bench failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 # --------------------------------------------------------------- quick mode
 # Input-pipeline A/B on CPU: prefetch-off vs prefetch-on through the REAL
 # Trainer (tiny synthetic task), plus a cold->warm --compile-cache-dir pair,
@@ -1075,6 +1346,26 @@ def main(argv=None):
     p.add_argument("--fleet-out", default="BENCH_fleet.json",
                    help="where --fleet writes its JSON")
     p.add_argument("--fleet-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--swap", action="store_true",
+                   help="hot-swap rollout bench on CPU: 2 replicas behind "
+                        "the router, a new checkpoint step published and "
+                        "rolled across the pool mid-load; reports the p99 "
+                        "delta during the rollout window, publish-to-"
+                        "convergence time and zero failed requests (no "
+                        "TPU, no probe)")
+    p.add_argument("--swap-requests", type=int, default=16,
+                   help="closed-loop requests per phase")
+    p.add_argument("--swap-concurrency", type=int, default=4,
+                   help="closed-loop client threads")
+    p.add_argument("--swap-max-new", type=int, default=48,
+                   help="tokens per request; long enough that a request "
+                        "is not dwarfed by the (constant, ~tens of ms on "
+                        "the tiny model) per-replica restore window, "
+                        "matching real serving where requests are long "
+                        "relative to a swap")
+    p.add_argument("--swap-out", default="BENCH_swap.json",
+                   help="where --swap writes its JSON")
+    p.add_argument("--swap-child", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.quick_child:
@@ -1086,6 +1377,18 @@ def main(argv=None):
     if args.fleet_child:
         _fleet_child(args.fleet_child)
         return {"fleet_child": True}
+    if args.swap_child:
+        _swap_child(args.swap_child)
+        return {"swap_child": True}
+    if args.swap:
+        result = run_swap(
+            requests=args.swap_requests,
+            concurrency=args.swap_concurrency,
+            max_new=args.swap_max_new,
+            out_path=args.swap_out,
+        )
+        print(json.dumps(result))
+        return result
     if args.fleet:
         result = run_fleet(
             requests=args.fleet_requests,

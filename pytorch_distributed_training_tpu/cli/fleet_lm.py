@@ -19,6 +19,13 @@ burned), and a fully-down pool answers 503 with ``Retry-After`` instead
 of hanging. ``PDT_TPU_FAULT=replica_crash:5@1`` etc. target individual
 replicas for chaos drills (see faults/inject.py).
 
+With ``--hotswap-poll-s N`` (and a ``--checkpoint-dir``) the fleet also
+closes the train→serve loop: newly published, manifest-verified
+checkpoint steps roll across the pool one replica at a time with zero
+downtime — a replica whose swap fails keeps its old weights (the router
+reports the resulting version skew) and a poisoned step is blocklisted,
+never retried (serve/hotswap.py).
+
 SIGTERM/SIGINT to THIS process drains the whole fleet: every replica
 stops admitting, finishes in-flight work and exits 75; the router goes
 down last.
@@ -62,6 +69,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-dir", default=None,
                    help="fleet/router telemetry JSONL dir; replicas write "
                         "their own streams under <dir>/replica-<i>")
+    p.add_argument("--hotswap-poll-s", type=float, default=0.0,
+                   help="poll --checkpoint-dir every this many seconds and "
+                        "roll newly published, manifest-verified steps "
+                        "across the pool one replica at a time (live "
+                        "weight reload, no restart; 0 = off)")
+    p.add_argument("--hotswap-verify", default="digest",
+                   choices=("size", "digest"),
+                   help="integrity level a step must pass before the "
+                        "rolling swap admits it")
     return p
 
 
@@ -134,6 +150,15 @@ def main(argv=None) -> dict:
         registry=registry,
     )
     fleet.start()
+    if args.hotswap_poll_s > 0 and args.checkpoint_dir:
+        # the fleet process (jax-free) runs the watcher; replicas receive
+        # rollouts through POST /swap, one at a time — their own pollers
+        # stay off so the rollout order is the coordinator's alone
+        fleet.enable_hotswap(
+            args.checkpoint_dir,
+            poll_interval_s=args.hotswap_poll_s,
+            verify_level=args.hotswap_verify,
+        )
     httpd = make_router_http_server(fleet.router, port=args.router_port)
     log0(
         f"fleet router on http://127.0.0.1:{httpd.server_address[1]} "

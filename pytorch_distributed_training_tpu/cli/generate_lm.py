@@ -74,13 +74,18 @@ def build_tokenizer(args):
 
 
 def load_model_and_params(args, tok):
-    """Resolve (model, params) from the CLI's checkpoint flags.
+    """Resolve ``(model, params, ckpt_step)`` from the CLI's checkpoint
+    flags (``ckpt_step`` is None for HF/random weights — serving reports
+    it as the boot ``weights_step``).
 
     Matches the checkpoint's trunk layout: train_lm defaults to the scanned
     trunk, and generate()/DecodeEngine re-lay scanned params out — the user
     never has to know how the checkpoint was trained. The step is resolved
     ONCE so the layout probe and the restore read the same checkpoint even
-    if a training run is writing new steps concurrently.
+    if a training run is writing new steps concurrently — and it prefers
+    the newest VERIFIED step (manifest integrity, train/manifest.py) so an
+    inference process never boots on a torn publish; a manifest-less
+    legacy directory falls back to the raw latest step.
     """
     from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
     from pytorch_distributed_training_tpu.utils.config import model_preset
@@ -92,7 +97,15 @@ def load_model_and_params(args, tok):
     if args.checkpoint_dir and not args.hf_checkpoint:
         from pytorch_distributed_training_tpu.train import checkpoint as ckpt
 
-        ckpt_step = ckpt.latest_step(args.checkpoint_dir)
+        ckpt_step = ckpt.verified_latest_step(args.checkpoint_dir)
+        if ckpt_step is None:
+            ckpt_step = ckpt.latest_step(args.checkpoint_dir)
+            if ckpt_step is not None:
+                log0(
+                    f"no integrity-verified checkpoint under "
+                    f"{args.checkpoint_dir} (legacy save?); loading latest "
+                    f"step {ckpt_step} unverified"
+                )
         if ckpt_step is None:
             raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
         scanned = ckpt.saved_params_scanned(args.checkpoint_dir, step=ckpt_step)
@@ -127,7 +140,7 @@ def load_model_and_params(args, tok):
             jax.random.key(args.seed),
             np.ones((1, 8), np.int32),
         )["params"]
-    return model, params
+    return model, params, ckpt_step
 
 
 def _trim_eot(ids: np.ndarray, tok, stop_at_eot: bool) -> np.ndarray:
@@ -164,7 +177,7 @@ def main(argv=None):
     for i, r in enumerate(rows):
         prompt_ids[i, : len(r)] = r
 
-    model, params = load_model_and_params(args, tok)
+    model, params, _step = load_model_and_params(args, tok)
 
     out = generate(
         model,

@@ -25,6 +25,13 @@ never queued unboundedly), ``--deadline-s`` default per-request deadline
 ``--metrics-dir`` streams per-request ``serve_request`` records (TTFT,
 TPOT, queue wait) through telemetry/; fold them into a percentile table
 with ``scripts/summarize_metrics.py``.
+
+Live reload: with ``--checkpoint-dir`` the server exposes ``POST /swap``
+(swap to a named step) and ``--hotswap-poll-s N`` additionally watches the
+directory, hot-swapping each newly published manifest-verified step into
+the running engine between ticks — no restart, in-flight requests keep
+streaming, and a corrupt publish rolls back to the serving weights
+(serve/hotswap.py).
 """
 
 from __future__ import annotations
@@ -66,6 +73,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="/healthz reports 'unhealthy' when the serve loop's "
                         "tick heartbeat is older than this (wedged loop "
                         "detection for routers/LBs)")
+    p.add_argument("--hotswap-poll-s", type=float, default=0.0,
+                   help="poll --checkpoint-dir every this many seconds and "
+                        "hot-swap newly published, manifest-verified steps "
+                        "into the running engine with no restart (0 = no "
+                        "polling; POST /swap still works when a checkpoint "
+                        "dir is given — the fleet coordinator drives it)")
+    p.add_argument("--hotswap-verify", default="digest",
+                   choices=("size", "digest"),
+                   help="integrity level a step must pass before a live "
+                        "swap admits it (digest re-hashes every file — the "
+                        "safe default for weights about to serve traffic)")
     p.add_argument("--metrics-dir", default=None,
                    help="stream serve telemetry (JSONL) under this directory")
     p.add_argument("--guards", default=None,
@@ -98,7 +116,7 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
     from pytorch_distributed_training_tpu.utils.logging import log0
 
     tok = build_tokenizer(args)
-    model, params = load_model_and_params(args, tok)
+    model, params, boot_step = load_model_and_params(args, tok)
 
     registry = get_registry()
     sink = None
@@ -137,7 +155,27 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             mode=args.guards or guard_mode_from_env(), registry=registry
         ),
         stall_timeout_s=args.stall_timeout_s,
+        weights_step=boot_step,
     ).start()
+
+    if args.checkpoint_dir and not args.hf_checkpoint:
+        # live reload: a continuously fine-tuning job publishes into the
+        # same --checkpoint-dir and this replica picks verified steps up
+        # with no restart (standalone mode polls; fleet mode drives the
+        # POST /swap endpoint instead and leaves polling off)
+        from pytorch_distributed_training_tpu.serve.hotswap import (
+            HotSwapManager,
+        )
+
+        server.attach_hotswap(
+            HotSwapManager(
+                server, args.checkpoint_dir,
+                poll_interval_s=args.hotswap_poll_s,
+                verify_level=args.hotswap_verify,
+                registry=registry,
+                start_step=boot_step,
+            ).start()
+        )
 
     preempted = {"signal": None}
     try:

@@ -47,6 +47,26 @@ the request stream):
                           slow replica) — drives deadline expiry and the
                           router's load-away-from-slow behavior.
 
+Swap-scoped kinds (fired from the hot-swap loader, serve/hotswap.py, when
+it loads the named CHECKPOINT STEP for a live weight swap — the argument
+is a checkpoint step, not a tick):
+
+- ``corrupt_ckpt_swap:12`` raise mid-load of checkpoint step 12 (the
+                          corrupt-array failure manifest verification
+                          missed) — exercises swap rollback: the replica
+                          stays serving its OLD weights, the step lands on
+                          the watcher's blocklist, the fleet converges on
+                          the next good step.
+- ``swap_crash:12``       hard-kill this replica (``os._exit``) mid-load of
+                          step 12 — a swap must never turn a replica crash
+                          into an outage: the supervisor respawns it and
+                          the fresh process boots on the newest verified
+                          step.
+- ``swap_slow:12:3``      sleep 3s (default 2) inside the load of step 12 —
+                          stretches the rollout window, driving the
+                          version-skew-duration telemetry and the
+                          p99-under-swap bench.
+
 Every spec fires AT MOST ONCE per process (a restarted attempt inside the
 same process does not re-fire; ``slow_host``/``replica_slow`` stay armed but
 record once), so an injected crash converges to recovery instead of
@@ -65,7 +85,13 @@ from pytorch_distributed_training_tpu.utils.logging import get_logger
 ENV_VAR = "PDT_TPU_FAULT"
 
 _STEP_KINDS = ("crash_at_step", "sigterm_at_step", "hang_at_step")
-_SERVE_KINDS = ("replica_crash", "replica_hang", "replica_slow")
+# serve-scoped: routed to fleet replicas by @rank (serve/fleet.py). The
+# replica_* kinds count busy engine ticks; the swap kinds key on the
+# checkpoint step the hot-swap loader is reading.
+_SWAP_KINDS = ("corrupt_ckpt_swap", "swap_crash", "swap_slow")
+_SERVE_KINDS = (
+    "replica_crash", "replica_hang", "replica_slow",
+) + _SWAP_KINDS
 _KINDS = _STEP_KINDS + ("corrupt_ckpt", "slow_host") + _SERVE_KINDS
 
 #: the exit status of a hard replica kill — anything but 0/75, so the fleet
@@ -109,15 +135,24 @@ def _parse_spec(text: str) -> FaultSpec:
     elif kind in _SERVE_KINDS:
         parts = arg.split(":")
         spec.step = int(parts[0])
-        if spec.step <= 0:
+        if kind in _SWAP_KINDS:
+            # checkpoint steps start at 0; busy ticks start at 1
+            if spec.step < 0:
+                raise ValueError(
+                    f"{kind} needs a checkpoint step >= 0, got {arg!r}"
+                )
+        elif spec.step <= 0:
             raise ValueError(f"{kind} needs a positive tick, got {arg!r}")
-        if kind == "replica_hang":
+        if kind in ("replica_hang", "swap_slow"):
             if len(parts) > 2:
-                raise ValueError(f"{kind} takes tick[:seconds], got {arg!r}")
+                raise ValueError(
+                    f"{kind} takes {'step' if kind == 'swap_slow' else 'tick'}"
+                    f"[:seconds], got {arg!r}"
+                )
             spec.factor = float(parts[1]) if len(parts) == 2 else 2.0
             if spec.factor <= 0:
                 raise ValueError(
-                    f"{kind} needs a positive hang duration, got {arg!r}"
+                    f"{kind} needs a positive duration, got {arg!r}"
                 )
         elif kind == "replica_slow":
             if len(parts) != 2:
@@ -130,7 +165,7 @@ def _parse_spec(text: str) -> FaultSpec:
                 )
             spec.factor = float(m.group(1))
         elif len(parts) != 1:
-            raise ValueError(f"{kind} takes a bare tick, got {arg!r}")
+            raise ValueError(f"{kind} takes a bare tick/step, got {arg!r}")
     elif kind == "corrupt_ckpt":
         if arg != "latest" and not arg.isdigit():
             raise ValueError(
@@ -260,6 +295,45 @@ class FaultPlan:
                     })
                 time.sleep(max(0.0, elapsed_s) * (spec.factor - 1.0))
                 return
+
+    def fire_swap_load(self, ckpt_step: int) -> None:
+        """Hot-swap loader hook (serve/hotswap.load_swap_params), called
+        BEFORE any bytes of checkpoint ``ckpt_step`` are read — so the
+        injected failure is deterministic and the engine's serving state
+        is provably untouched when it fires."""
+        spec = self._take("corrupt_ckpt_swap", lambda s: s.step == ckpt_step)
+        if spec is not None:
+            _emit({"fault": "corrupt_ckpt_swap", "ckpt_step": ckpt_step})
+            logger.warning(
+                "injecting corrupt-array failure into swap load of "
+                "checkpoint step %d", ckpt_step,
+            )
+            raise InjectedCrash(
+                f"injected corrupt checkpoint array during swap load of "
+                f"step {ckpt_step}"
+            )
+        spec = self._take("swap_crash", lambda s: s.step == ckpt_step)
+        if spec is not None:
+            _emit({"fault": "swap_crash", "ckpt_step": ckpt_step})
+            logger.warning(
+                "injecting replica crash during swap load of checkpoint "
+                "step %d", ckpt_step,
+            )
+            self._flush_sink()
+            os._exit(REPLICA_CRASH_EXIT_CODE)  # the rollout must survive a
+            # replica dying mid-swap: supervisor respawns, fresh process
+            # boots on the newest verified step
+        spec = self._take("swap_slow", lambda s: s.step == ckpt_step)
+        if spec is not None:
+            _emit({
+                "fault": "swap_slow", "ckpt_step": ckpt_step,
+                "seconds": spec.factor,
+            })
+            logger.warning(
+                "injecting %.1fs stall into swap load of checkpoint step "
+                "%d", spec.factor, ckpt_step,
+            )
+            time.sleep(spec.factor)
 
     @staticmethod
     def _flush_sink() -> None:

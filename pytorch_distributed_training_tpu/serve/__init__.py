@@ -23,7 +23,16 @@ the repo with no way to SERVE a model; this package is that missing half:
                 fail-fast 503 + Retry-After when the pool is down;
 - ``fleet``   — replica-pool supervision: serve_lm subprocesses under the
                 supervisor restart contract (crash -> backoff respawn
-                within a budget; SIGTERM -> drain, exit 75, respawn free).
+                within a budget; SIGTERM -> drain, exit 75, respawn free),
+                plus the rolling-swap coordinator driving one-replica-at-
+                a-time checkpoint rollouts;
+- ``hotswap`` — zero-downtime checkpoint hot-swap: a manifest-verified
+                watcher admits newly published steps (never twice, never
+                backwards, poisoned steps blocklisted), the replica-side
+                manager loads and swaps them live through the engine's
+                between-tick trial/commit/rollback protocol, and
+                ``publish_params_checkpoint`` is the publisher half of the
+                contract.
 
 Observability and failure handling ride the existing subsystems:
 per-request TTFT/TPOT/queue-wait records and queue-depth/slot-occupancy
@@ -45,7 +54,13 @@ from pytorch_distributed_training_tpu.serve.queue import (
 )
 from pytorch_distributed_training_tpu.serve.fleet import (
     FleetConfig,
+    RollingSwapCoordinator,
     ServeFleet,
+)
+from pytorch_distributed_training_tpu.serve.hotswap import (
+    CheckpointWatcher,
+    HotSwapManager,
+    publish_params_checkpoint,
 )
 from pytorch_distributed_training_tpu.serve.router import (
     CircuitBreaker,
@@ -61,17 +76,21 @@ from pytorch_distributed_training_tpu.serve.server import (
 
 __all__ = [
     "BackpressureError",
+    "CheckpointWatcher",
     "CircuitBreaker",
     "DecodeEngine",
     "EngineConfig",
     "FleetConfig",
     "GenRequest",
+    "HotSwapManager",
     "InferenceServer",
     "RequestQueue",
+    "RollingSwapCoordinator",
     "Router",
     "RouterConfig",
     "ServeFleet",
     "make_http_server",
     "make_router_http_server",
+    "publish_params_checkpoint",
     "serve_stdio",
 ]

@@ -47,11 +47,26 @@ like a training collective); each tick routes through
 stretches serving time deterministically (deadline/backpressure drills);
 per-request TTFT/TPOT/queue-wait and tick-level queue-depth/slot-
 occupancy go through ``telemetry/`` (JSONL via the process-0-gated sink).
+
+Live weight hot-swap (serve/hotswap.py): ``request_swap(params, version)``
+queues a validated replacement params tree from any thread; the serve
+loop applies it at the START of the next tick (``swap_params`` — never
+mid-tick, so a tick is never torn between two weight versions) and the
+OLD params stay alive until the first post-swap tick completes cleanly
+(trial/commit; a trial-tick failure rolls back to them). The resident KV
+cache is untouched by a swap — in-flight slots simply continue decoding
+on the new weights (documented contract; their KV prefix was computed
+under the old version) — and because the replacement tree is validated
+to the same treedef/shapes/dtypes and pre-placed on device, the swap hits
+the existing compiled programs (no retrace, no implicit transfer: clean
+under ``PDT_TPU_GUARDS=strict``). Only the cache is donated, so holding
+the previous params through the trial window is free of copies.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Optional
 
@@ -129,6 +144,27 @@ class _Slot:
     steps_done: int = 0         # decode steps already executed for this slot
 
 
+@dataclasses.dataclass
+class SwapTicket:
+    """Outcome handle for one requested weight swap: ``done`` fires when
+    the engine committed (``ok=True``) or rolled back (``ok=False``) the
+    swap — the requesting thread blocks on it, never on the serve loop."""
+
+    version: Optional[int]
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    ok: Optional[bool] = None
+    error: Optional[str] = None
+    stage: Optional[str] = None
+
+    def resolve(self, ok: bool, *, error: str = None, stage: str = None):
+        self.ok = ok
+        self.error = error
+        self.stage = stage
+        self.done.set()
+
+
 class DecodeEngine:
     """Slotted continuous-batching decode over a causal LM.
 
@@ -145,6 +181,7 @@ class DecodeEngine:
         *,
         registry=None,
         guards: Optional[GuardSet] = None,
+        weights_step: Optional[int] = None,
     ):
         cfg = model.config
         if not cfg.causal:
@@ -168,8 +205,20 @@ class DecodeEngine:
                 f"{cfg.max_position_embeddings}"
             )
         self._decode_model = type(model)(dataclasses.replace(cfg, decode=True))
-        self._params = params
+        # explicit placement: restored checkpoints arrive as host arrays,
+        # and a host tree reaching the warm compiled calls would be an
+        # implicit per-tick H2D (a strict-mode transfer violation)
+        self._params = jax.device_put(params)
         self._queue = queue
+        # live weight-swap state: version served, one pending (validated,
+        # device-placed) replacement, and the trial window's keep-alive of
+        # the previous params until the first post-swap tick commits
+        self.weights_step = weights_step
+        self.swaps = 0              # committed swaps
+        self.swap_rollbacks = 0     # trial-tick failures rolled back
+        self._swap_lock = threading.Lock()
+        self._pending_swap = None   # (params, version, SwapTicket)
+        self._trial = None          # (prev_params, prev_version, ticket)
         if registry is None:
             from pytorch_distributed_training_tpu.telemetry.registry import (
                 get_registry,
@@ -293,6 +342,122 @@ class DecodeEngine:
         )
         return self._decode_fn
 
+    # ------------------------------------------------------------- hot swap
+
+    @property
+    def params(self):
+        """The currently-serving params tree (hot-swap loaders build their
+        restore spec from it; reading the reference is thread-safe)."""
+        return self._params
+
+    @staticmethod
+    def _params_spec(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return treedef, [
+            (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
+        ]
+
+    def _validate_swap(self, params) -> None:
+        """A replacement tree must match the running model exactly —
+        anything else would retrace (new shapes/dtypes) or crash mid-tick
+        (new structure). Checked BEFORE any engine state changes."""
+        cur_def, cur_spec = self._params_spec(self._params)
+        new_def, new_spec = self._params_spec(params)
+        if cur_def != new_def:
+            raise ValueError(
+                "swap rejected: params tree structure does not match the "
+                "running model"
+            )
+        for i, (cur, new) in enumerate(zip(cur_spec, new_spec)):
+            if cur != new:
+                raise ValueError(
+                    f"swap rejected: leaf {i} is {new[0]}/{new[1]}, running "
+                    f"model has {cur[0]}/{cur[1]} (shape/dtype mismatch — "
+                    f"checkpoint from an incompatible model config)"
+                )
+
+    def request_swap(self, params, version: Optional[int]) -> SwapTicket:
+        """Queue a validated weight swap from ANY thread; the serve loop
+        applies it between ticks. Returns a ticket whose ``done`` event
+        fires at commit or rollback. Raises ``ValueError`` on a tree that
+        can't serve under the running model (nothing is queued) and
+        ``RuntimeError`` while another swap is still in flight."""
+        self._validate_swap(params)
+        placed = jax.device_put(params)
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                raise RuntimeError(
+                    "a weight swap is already pending; one at a time"
+                )
+            ticket = SwapTicket(version)
+            self._pending_swap = (placed, version, ticket)
+        return ticket
+
+    def swap_params(self, params, version: Optional[int],
+                    ticket: Optional[SwapTicket] = None) -> None:
+        """Atomically install ``params`` as the serving weights. MUST run
+        between ticks (the serve loop calls it at tick start via
+        ``request_swap``; direct calls are for single-threaded use). The
+        resident KV cache and the compiled programs are untouched — slots
+        in flight continue on the new weights — and the previous params are
+        kept alive until ``_commit_swap`` (first clean post-swap tick)."""
+        self._validate_swap(params)
+        prev_params, prev_version = self._params, self.weights_step
+        self._params = jax.device_put(params)
+        self.weights_step = version
+        self._trial = (prev_params, prev_version, ticket)
+        self._registry.inc("serve/swaps_applied")
+        self._registry.emit({
+            "record": "swap_applied",
+            "version": version,
+            "from_version": prev_version,
+        })
+
+    def _commit_swap(self) -> None:
+        _prev, _prev_version, ticket = self._trial
+        self._trial = None
+        self.swaps += 1
+        self._registry.inc("serve/swaps")
+        self._registry.gauge("serve/weights_step", self.weights_step)
+        self._registry.emit({
+            "record": "swap_committed",
+            "version": self.weights_step,
+        })
+        if ticket is not None:
+            ticket.resolve(True)
+
+    def _rollback_swap(self, error: str) -> None:
+        """The first post-swap tick failed: restore the previous params
+        (never donated, still alive) and record the failure. The KV cache
+        may hold a torn tick's state only if the failure happened INSIDE a
+        compiled call — the deterministic drills fire before dispatch, and
+        a genuinely torn cache is the serve loop failure path's problem."""
+        prev_params, prev_version, ticket = self._trial
+        self._trial = None
+        failed_version = self.weights_step
+        self._params = prev_params
+        self.weights_step = prev_version
+        self.swap_rollbacks += 1
+        self._registry.inc("serve/swap_rollbacks")
+        self._registry.emit({
+            "record": "swap_failed",
+            "version": failed_version,
+            "stage": "tick",
+            "error": error,
+        })
+        self._registry.emit({
+            "record": "swap_rollback",
+            "from_version": failed_version,
+            "to_version": prev_version,
+            "stage": "tick",
+        })
+        logger.error(
+            "post-swap tick failed (%s); rolled back to weights step %s",
+            error, prev_version,
+        )
+        if ticket is not None:
+            ticket.resolve(False, error=error, stage="tick")
+
     # -------------------------------------------------------------- sampling
 
     def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
@@ -346,6 +511,10 @@ class DecodeEngine:
                 if req.finish_t is not None
                 else None
             ),
+            # which weights version produced this answer — the join key a
+            # rollout post-mortem needs (mid-rollout, different replicas
+            # legitimately answer from different steps)
+            "weights_step": self.weights_step,
         })
 
     def _finish(self, req: GenRequest, status: str, reason: str) -> None:
@@ -427,9 +596,44 @@ class DecodeEngine:
     # ------------------------------------------------------------------ tick
 
     def tick(self) -> bool:
-        """One engine iteration: expire, admit, decode one token for every
-        active slot. Returns True when any work happened (the serve loop
-        idles on the queue condition otherwise)."""
+        """One engine iteration: apply a pending weight swap, then expire,
+        admit, decode one token for every active slot. Returns True when
+        any work happened (the serve loop idles on the queue condition
+        otherwise).
+
+        Swap protocol: a queued ``request_swap`` is installed HERE, at the
+        boundary between ticks — the tick body then runs entirely on the
+        new weights (never torn across versions). The swap stays in its
+        trial window until the body completes: a clean tick commits it
+        (previous params released), a failing tick rolls back to the old
+        params and the loop keeps serving — a bad swap must degrade the
+        weights version, not availability.
+        """
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is not None:
+            params, version, ticket = pending
+            try:
+                self.swap_params(params, version, ticket)
+            except Exception as e:  # pragma: no cover - validated at request
+                if ticket is not None:
+                    ticket.resolve(
+                        False, error=f"{type(e).__name__}: {e}",
+                        stage="apply",
+                    )
+        try:
+            worked = self._tick_body()
+        except Exception as e:
+            if self._trial is not None:
+                self._rollback_swap(f"{type(e).__name__}: {e}")
+                self.last_tick_t = time.monotonic()
+                return True
+            raise
+        if self._trial is not None:
+            self._commit_swap()
+        return worked
+
+    def _tick_body(self) -> bool:
         t0 = time.monotonic()
         worked = False
 
@@ -544,6 +748,10 @@ class DecodeEngine:
             "num_slots": self.config.num_slots,
             "prompt_buckets": list(self.config.prompt_buckets),
             "compiled_prefill_buckets": sorted(self._prefill_fns),
+            "weights_step": self.weights_step,
+            "swaps": self.swaps,
+            "swap_rollbacks": self.swap_rollbacks,
+            "swap_pending": self._pending_swap is not None,
             "guard_mode": self._guards.mode,
             "guard_recompiles": self._guards.recompile_violations,
             "guard_implicit_transfers": self._guards.transfer_violations,

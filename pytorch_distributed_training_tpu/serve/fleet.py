@@ -47,6 +47,9 @@ from pytorch_distributed_training_tpu.faults.preemption import (
     RESUMABLE_EXIT_CODE,
     Preempted,
 )
+from pytorch_distributed_training_tpu.serve.hotswap import (
+    CheckpointWatcher,
+)
 from pytorch_distributed_training_tpu.serve.router import (
     Router,
     RouterConfig,
@@ -321,6 +324,161 @@ class ReplicaProcess:
         }
 
 
+class RollingSwapCoordinator:
+    """One-replica-at-a-time checkpoint rollout across the pool.
+
+    The fleet process runs the SAME ``CheckpointWatcher`` a standalone
+    replica would (jax-free: manifest scan + verify only) and, for each
+    admitted step, drives the replicas' ``POST /swap`` endpoints in index
+    order — strictly one at a time, waiting for each replica's synchronous
+    outcome before touching the next, so at most one replica is ever
+    mid-swap and the pool's serving capacity never dips.
+
+    Failure policy mirrors the replica-side contract: a replica whose swap
+    fails (409, connection error, timeout) KEEPS its old weights and stays
+    in rotation — degraded-version, not dead — and the rollout continues
+    to the next replica. A step no replica could take is blocklisted by
+    the watcher (poisoned publish: never retried); a partially-rolled-out
+    step is also never re-driven — convergence comes from the next good
+    step, or from a respawned replica booting on the newest verified step.
+    Telemetry: per-replica ``fleet_swap_replica`` records and one
+    ``fleet_swap`` rollout record (duration = the version-skew window the
+    router independently measures via ``router_skew``).
+    """
+
+    def __init__(
+        self,
+        fleet: "ServeFleet",
+        checkpoint_dir: str,
+        *,
+        poll_interval_s: float = 0.5,
+        verify_level: str = "digest",
+        registry=None,
+        swap_timeout_s: float = 120.0,
+    ):
+        self._fleet = fleet
+        self._registry = registry if registry is not None else fleet._registry
+        self.swap_timeout_s = swap_timeout_s
+        self.rollouts = 0
+        self.rollouts_converged = 0
+        self.watcher = CheckpointWatcher(
+            checkpoint_dir,
+            self._rollout,
+            poll_interval_s=poll_interval_s,
+            verify_level=verify_level,
+            registry=self._registry,
+            name="fleet-hotswap",
+        )
+
+    def start(self) -> "RollingSwapCoordinator":
+        self.watcher.start()
+        return self
+
+    def close(self) -> None:
+        self.watcher.close()
+
+    def _eligible(self, replica: ReplicaProcess) -> bool:
+        """Only roll a replica that is up and in rotation: one mid-boot is
+        skipped (it boots on the newest verified step anyway), one failed
+        or draining has no swap to receive."""
+        proc = replica.proc
+        if replica.state != "up" or proc is None or proc.poll() is not None:
+            return False
+        view = next(
+            (r for r in self._fleet.router.replicas
+             if r.name == replica.name), None,
+        )
+        return view is not None and view.available()
+
+    def _swap_replica(self, replica: ReplicaProcess, step: int) -> dict:
+        import http.client
+        import json
+
+        try:
+            conn = http.client.HTTPConnection(
+                self._fleet.config.host, replica.port,
+                timeout=self.swap_timeout_s,
+            )
+            try:
+                conn.request(
+                    "POST", "/swap",
+                    body=json.dumps({"step": step}),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                out = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+            out.setdefault("ok", False)
+            return out
+        except Exception as e:      # conn refused/reset/timeout (e.g. the
+            # swap_crash drill killing the replica mid-load)
+            return {"ok": False, "stage": "http", "error": repr(e)}
+
+    def _rollout(self, step: int) -> bool:
+        """Watcher apply hook: roll ``step`` across the pool. True unless
+        NO replica could take it (which blocklists the step)."""
+        t0 = time.monotonic()
+        self.rollouts += 1
+        results: dict[str, str] = {}
+        for replica in self._fleet.replicas:
+            if not self._eligible(replica):
+                results[replica.name] = "skipped"
+                continue
+            r0 = time.monotonic()
+            out = self._swap_replica(replica, step)
+            ok = bool(out.get("ok"))
+            results[replica.name] = "ok" if ok else "failed"
+            self._registry.inc(
+                "fleet/swap_ok" if ok else "fleet/swap_failed"
+            )
+            self._registry.emit({
+                "record": "fleet_swap_replica",
+                "step": step,
+                "replica": replica.name,
+                "ok": ok,
+                "duration_s": time.monotonic() - r0,
+                **({} if ok else {
+                    "stage": out.get("stage"),
+                    "error": out.get("error"),
+                }),
+            })
+            if not ok:
+                logger.warning(
+                    "rolling swap: replica %s refused step %d (%s); it "
+                    "stays on its old weights", replica.name, step,
+                    out.get("error"),
+                )
+        ok_n = sum(1 for v in results.values() if v == "ok")
+        fail_n = sum(1 for v in results.values() if v == "failed")
+        converged = fail_n == 0
+        self._registry.emit({
+            "record": "fleet_swap",
+            "step": step,
+            "results": results,
+            "ok": ok_n,
+            "failed": fail_n,
+            "skipped": len(results) - ok_n - fail_n,
+            "duration_s": time.monotonic() - t0,
+            "converged": converged,
+        })
+        if converged:
+            self.rollouts_converged += 1
+        # a step EVERY eligible replica rejected is poisoned — blocklist it
+        # (False); a partial or skipped rollout still advances (the step is
+        # live somewhere, or nobody was up to take it and respawns will
+        # boot straight onto it)
+        return ok_n > 0 or fail_n == 0
+
+    def stats(self) -> dict:
+        return {
+            "rollouts": self.rollouts,
+            "rollouts_converged": self.rollouts_converged,
+            "current_step": self.watcher.current_step,
+            "blocklist": sorted(self.watcher.blocklist),
+        }
+
+
 class ServeFleet:
     """N supervised replicas + one router, started and stopped together."""
 
@@ -354,6 +512,29 @@ class ServeFleet:
             router_config,
             registry=registry,
         )
+        self.hotswap: Optional[RollingSwapCoordinator] = None
+
+    def enable_hotswap(
+        self,
+        checkpoint_dir: str,
+        *,
+        poll_interval_s: float = 0.5,
+        verify_level: str = "digest",
+        swap_timeout_s: float = 120.0,
+    ) -> RollingSwapCoordinator:
+        """Attach (and start) the rolling-swap coordinator: new verified
+        checkpoint steps under ``checkpoint_dir`` roll across the pool one
+        replica at a time with no restart."""
+        if self.hotswap is not None:
+            raise RuntimeError("fleet hot-swap already enabled")
+        self.hotswap = RollingSwapCoordinator(
+            self, checkpoint_dir,
+            poll_interval_s=poll_interval_s,
+            verify_level=verify_level,
+            registry=self._registry,
+            swap_timeout_s=swap_timeout_s,
+        ).start()
+        return self.hotswap
 
     def start(self) -> "ServeFleet":
         for replica in self.replicas:
@@ -381,7 +562,10 @@ class ServeFleet:
         return self.replicas[index]
 
     def stop(self, *, drain: bool = True) -> None:
-        """Drain (or kill) every replica, stop respawns, stop the router."""
+        """Drain (or kill) every replica, stop respawns, stop the router
+        (and the rollout coordinator first — no swap starts mid-drain)."""
+        if self.hotswap is not None:
+            self.hotswap.close()
         for replica in self.replicas:
             replica.stop(drain=drain)
         join_s = self.config.drain_timeout_s + 10.0 if drain else 10.0
@@ -390,7 +574,10 @@ class ServeFleet:
         self.router.close()
 
     def stats(self) -> dict:
-        return {
+        stats = {
             "replicas": [r.describe() for r in self.replicas],
             "router": self.router.stats(),
         }
+        if self.hotswap is not None:
+            stats["hotswap"] = self.hotswap.stats()
+        return stats

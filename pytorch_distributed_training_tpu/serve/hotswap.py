@@ -1,0 +1,558 @@
+"""Zero-downtime checkpoint hot-swap: verified live weight reload.
+
+The train side continuously publishes checkpoints (train/checkpoint.py,
+sealed by train/manifest.py); until now the serve side treated weights as
+frozen at process start — a fine-tuning job could only reach the fleet
+through a full drain/exit-75/respawn cycle per replica. This module closes
+that train→serve loop, and does it so a BAD checkpoint is a non-event:
+
+- ``CheckpointWatcher`` polls a checkpoint directory and admits only steps
+  that pass the existing manifest integrity verification
+  (``train/manifest.verify_step`` — the same checker behind
+  ``verified_latest_step``). Admission is monotonic: a step is never
+  admitted twice and the watcher never goes backwards; a step whose swap
+  failed lands on a per-step blocklist (no poisoned-step retry loop); a
+  step re-published with DIFFERENT digests is rejected and logged (a
+  publisher must never mutate a sealed step). The watcher is jax-free on
+  purpose — the fleet coordinator runs it in a process that never touches
+  an accelerator.
+- ``load_swap_params`` reads ONLY the params subtree of an admitted step
+  (partial restore — the Adam moments are never touched), re-lays a
+  scanned trunk out to the engine's unstacked layout, and places the
+  leaves on device explicitly (a host array reaching a hot call is
+  exactly what ``PDT_TPU_GUARDS=strict`` forbids).
+- ``HotSwapManager`` is the replica-side executor: load (off the serve
+  loop — a slow disk must not stall a tick), hand the placed tree to
+  ``DecodeEngine.request_swap``, and wait for the engine to apply it
+  between ticks and commit it after the first successful post-swap tick.
+  A swap that fails at any stage — corrupt array, shape mismatch against
+  the running model, apply failure — leaves the OLD weights serving
+  (``swap_failed`` telemetry + rollback accounting), never a dead replica.
+- ``publish_params_checkpoint`` is the publisher half of the contract:
+  params-only orbax step + sealed manifest, what a fine-tuning job (or a
+  test/bench) calls to make a step eligible for pickup.
+
+Fault drills: ``PDT_TPU_FAULT=corrupt_ckpt_swap:<step>`` /
+``swap_crash:<step>`` / ``swap_slow:<step>[:s]`` fire inside
+``load_swap_params`` (faults/inject.py), so the rollback, supervisor-
+respawn and slow-rollout paths run for real in tier-1 chaos drills.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from pytorch_distributed_training_tpu.train.manifest import (
+    read_manifest,
+    verify_step,
+)
+from pytorch_distributed_training_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SWAP_VERIFY_LEVELS = ("size", "digest")
+
+
+def _registry_or_default(registry):
+    if registry is not None:
+        return registry
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        get_registry,
+    )
+
+    return get_registry()
+
+
+def scan_step_dirs(directory: str) -> list[int]:
+    """Step numbers under ``directory`` (orbax standard layout: one
+    integer-named directory per step), sorted ascending. Non-step entries
+    (tmp dirs, metrics, stray files) are ignored — the watcher must not
+    need orbax (or jax) to enumerate candidates."""
+    try:
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    steps = []
+    for name in names:
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            steps.append(int(name))
+    return sorted(steps)
+
+
+def manifest_digest(manifest: dict) -> str:
+    """Stable fingerprint of a sealed step's content: the manifest's file
+    inventory (sizes + sha256s) hashed in sorted order. Two publishes of
+    the same step with different bytes get different fingerprints even
+    when sizes match."""
+    return hashlib.sha256(
+        json.dumps(manifest.get("files", {}), sort_keys=True).encode()
+    ).hexdigest()
+
+
+class CheckpointWatcher:
+    """Polls a checkpoint directory and drives ``apply_fn`` for each newly
+    published, integrity-verified step.
+
+    ``apply_fn(step) -> bool`` performs the actual swap (replica-side: load
+    + engine swap; fleet-side: rolling rollout) and returns True when the
+    step is now serving (or acceptably rolled out). False blocklists the
+    step — the watcher will NEVER retry it; recovery is the next good step.
+
+    Admission rules, in order:
+    - a step NEWLY APPEARING at or below ``current_step`` is stale
+      (published out of order) — rejected once with a ``swap_rejected``
+      record, never applied; older steps already sitting in the directory
+      when the watcher first looks (keep=N retention history) are normal
+      and ignored silently;
+    - a previously-seen step whose manifest digests changed is rejected +
+      blocklisted (``reason="republished"``): sealed steps are immutable;
+    - a step without a readable manifest, or failing ``verify_step`` at
+      ``verify_level``, is simply skipped this poll (an in-flight publish
+      finishes eventually; corruption keeps failing verification forever)
+      — NOT blocklisted, because "not yet eligible" is not "poisoned";
+    - among eligible new steps the NEWEST wins (same semantics as
+      ``verified_latest_step``); older eligible ones are only tried when
+      the newer admission fails.
+
+    ``start_step`` anchors the baseline (what is already serving). With
+    None the first poll records the newest verified step as baseline
+    without applying it — the caller booted from it.
+
+    Thread lifecycle: ``start()`` launches the poll thread; ``close()``
+    stops it and joins (a poll in flight finishes its apply first — swaps
+    are not torn by shutdown). ``poll_once()`` is the synchronous core,
+    callable directly from tests.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        apply_fn: Callable[[int], bool],
+        *,
+        poll_interval_s: float = 0.5,
+        verify_level: str = "digest",
+        registry=None,
+        start_step: Optional[int] = None,
+        name: str = "ckpt-watcher",
+    ):
+        if verify_level not in SWAP_VERIFY_LEVELS:
+            raise ValueError(
+                f"hot-swap verify level must be one of {SWAP_VERIFY_LEVELS},"
+                f" got {verify_level!r}"
+            )
+        self.directory = os.path.abspath(directory)
+        self.apply_fn = apply_fn
+        self.poll_interval_s = poll_interval_s
+        self.verify_level = verify_level
+        self.name = name
+        self._registry = _registry_or_default(registry)
+        self.current_step: Optional[int] = start_step
+        self.blocklist: set[int] = set()
+        self._digests: dict[int, str] = {}
+        # every step ever observed: "published out of order" means a step
+        # NEWLY APPEARING below the serving one — the older steps already
+        # sitting in the directory at startup (keep=N retention) are
+        # normal history, not a publisher error
+        self._seen: set[int] = set()
+        self._primed = False
+        self.polls = 0
+        self.admitted = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # a bad poll (transient IO, racing publisher) must not kill
+                # the watcher — the next poll sees a settled directory
+                self._registry.inc("swap/watcher_errors")
+                logger.exception("%s: poll failed", self.name)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop polling; a poll in flight (including its apply) completes
+        before the thread exits. Idempotent."""
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():  # pragma: no cover - wedged apply_fn
+                logger.error(
+                    "%s: poll still in flight after %.1fs close timeout",
+                    self.name, timeout,
+                )
+
+    # ----------------------------------------------------------------- poll
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _reject(self, step: int, reason: str) -> None:
+        logger.warning(
+            "%s: rejecting checkpoint step %d (%s)", self.name, step, reason
+        )
+        self._registry.inc("swap/rejected")
+        self._registry.emit({
+            "record": "swap_rejected",
+            "step": step,
+            "reason": reason,
+        })
+
+    def _check_republished(self, steps: list[int]) -> None:
+        """A sealed step's digests must never change. If a step we already
+        fingerprinted reappears with a different inventory, reject it loudly
+        and blocklist — silently serving either version would make the
+        fleet's ``weights_step`` a lie."""
+        for step in steps:
+            old = self._digests.get(step)
+            if old is None:
+                continue
+            manifest = read_manifest(self._step_path(step))
+            if not manifest:
+                continue
+            new = manifest_digest(manifest)
+            if new != old:
+                self._digests[step] = new  # reject once per re-publish
+                self.blocklist.add(step)
+                self._reject(step, "republished with different digests")
+
+    def poll_once(self) -> Optional[int]:
+        """One poll: returns the step admitted AND applied this round, or
+        None (nothing new, nothing eligible, or the apply failed)."""
+        self.polls += 1
+        steps = scan_step_dirs(self.directory)
+        self._check_republished(steps)
+        new_steps = [s for s in steps if s not in self._seen]
+        self._seen.update(steps)
+        primed, self._primed = self._primed, True
+        if self.current_step is None:
+            # baseline: the caller is already serving the newest verified
+            # step (it booted from it) — record it, don't re-apply it
+            base = -1
+            for step in sorted(steps, reverse=True):
+                ok, _ = verify_step(
+                    self._step_path(step), level=self.verify_level
+                )
+                if ok:
+                    base = step
+                    break
+            self.current_step = base
+            self._registry.emit({
+                "record": "swap_baseline", "step": base,
+            })
+            return None
+        if primed:
+            for step in sorted(new_steps):
+                if step <= self.current_step:
+                    self._reject(step, "older than serving step")
+        candidates = [
+            s for s in sorted(steps, reverse=True)
+            if s > self.current_step and s not in self.blocklist
+        ]
+        for step in candidates:
+            path = self._step_path(step)
+            manifest = read_manifest(path)
+            if not manifest:
+                # no (readable) manifest yet: a publish in flight — wait,
+                # don't blocklist ("not yet sealed" is recoverable)
+                continue
+            ok, reason = verify_step(path, level=self.verify_level)
+            if not ok:
+                logger.info(
+                    "%s: step %d not admitted (%s)", self.name, step, reason
+                )
+                continue
+            self._digests[step] = manifest_digest(manifest)
+            self._registry.inc("swap/admitted")
+            self._registry.emit({
+                "record": "swap_admitted",
+                "step": step,
+                "from_step": self.current_step,
+            })
+            if self._stop.is_set() and self.admitted:
+                # closing: don't start a NEW rollout mid-shutdown
+                return None
+            if self.apply_fn(step):
+                self.admitted += 1
+                self.current_step = step
+                return step
+            self.blocklist.add(step)
+            self._registry.inc("swap/blocklisted")
+            self._registry.emit({
+                "record": "swap_blocklisted", "step": step,
+            })
+            # fall through: an OLDER new step may still be good
+        return None
+
+
+# ------------------------------------------------------------------ loading
+
+
+def load_swap_params(directory: str, step: int, *, current_params):
+    """Load the params subtree of checkpoint ``step`` for a live swap.
+
+    Partial restore against ``current_params``' structure when layouts
+    match (the optimizer state is never read); a scanned-trunk checkpoint
+    is restored whole and re-laid out to the engine's unstacked layout.
+    Leaves are explicitly placed on device — the engine's strict transfer
+    guard treats an implicit per-tick H2D as a violation, so the one
+    legitimate transfer happens HERE, once, off the serve loop.
+
+    Raises on any load problem (missing step, corrupt array, structure
+    mismatch) — the caller maps that to swap_failed + rollback.
+    """
+    from pytorch_distributed_training_tpu.faults.inject import get_plan
+
+    # deterministic chaos hooks: corrupt_ckpt_swap raises (the torn-array
+    # failure verification missed), swap_crash hard-kills mid-load (the
+    # supervisor-respawn drill), swap_slow stretches the rollout window
+    get_plan().fire_swap_load(step)
+
+    import jax
+
+    from pytorch_distributed_training_tpu.models.relayout import (
+        has_scanned_trunk,
+        unstack_scanned_params,
+    )
+    from pytorch_distributed_training_tpu.train.checkpoint import (
+        restore_params,
+        saved_params_scanned,
+    )
+
+    if saved_params_scanned(directory, step=step) and not has_scanned_trunk(
+        current_params
+    ):
+        params = unstack_scanned_params(
+            restore_params(directory, step=step)
+        )
+    else:
+        params = restore_params(
+            directory, params_like=current_params, step=step
+        )
+    return jax.device_put(params)
+
+
+class HotSwapManager:
+    """Replica-side hot-swap executor: watcher + loader + engine swap.
+
+    One manager per ``InferenceServer``. ``swap_to(step)`` is synchronous
+    and serialized (the fleet coordinator's ``POST /swap`` and the local
+    watcher can't tear each other); the optional watcher
+    (``poll_interval_s > 0``) drives it autonomously in standalone-replica
+    mode. A failed swap NEVER touches the serving weights: load/validate
+    failures happen before the engine sees anything, and an apply-stage
+    failure is rolled back by the engine itself — either way the replica
+    stays healthy on its old ``weights_step`` (degraded-version, not dead)
+    and the failure is recorded (``swap_failed`` + rollback counters).
+    """
+
+    def __init__(
+        self,
+        server,
+        checkpoint_dir: str,
+        *,
+        poll_interval_s: float = 0.0,
+        verify_level: str = "digest",
+        registry=None,
+        start_step: Optional[int] = None,
+        apply_timeout_s: float = 60.0,
+    ):
+        self._server = server
+        self.checkpoint_dir = os.path.abspath(checkpoint_dir)
+        self.apply_timeout_s = apply_timeout_s
+        self._registry = _registry_or_default(registry)
+        self._lock = threading.Lock()
+        self.attempts = 0
+        self.failures = 0
+        # advertised on /healthz while a load+apply is in flight: the
+        # checkpoint restore competes with the decode loop for this
+        # process's CPU, so the router soft-penalizes a swapping replica
+        # (load-away, NOT derotation — the swap is still zero-downtime
+        # even on a one-replica pool)
+        self.swapping = False
+        self.watcher = CheckpointWatcher(
+            checkpoint_dir,
+            self._apply_step,
+            poll_interval_s=poll_interval_s,
+            verify_level=verify_level,
+            registry=self._registry,
+            start_step=(
+                start_step if start_step is not None
+                else server.engine.weights_step
+            ),
+            name="replica-hotswap",
+        )
+        self._polling = poll_interval_s > 0
+
+    def start(self) -> "HotSwapManager":
+        if self._polling:
+            self.watcher.start()
+        return self
+
+    def close(self) -> None:
+        self.watcher.close()
+
+    def _apply_step(self, step: int) -> bool:
+        return bool(self.swap_to(step).get("ok"))
+
+    def swap_to(self, step: int) -> dict:
+        """Load checkpoint ``step`` and swap it live. Returns a dict with
+        ``ok`` plus either the new ``weights_step`` or the failure's
+        ``stage``/``error`` (the /swap endpoint returns it verbatim)."""
+        step = int(step)
+        with self._lock:
+            try:
+                self.swapping = True
+                return self._swap_to_locked(step)
+            finally:
+                self.swapping = False
+
+    def _swap_to_locked(self, step: int) -> dict:
+        engine = self._server.engine
+        if engine.weights_step == step:
+            return {"ok": True, "weights_step": step, "noop": True}
+        self.attempts += 1
+        self._registry.emit({
+            "record": "swap_begin",
+            "version": step,
+            "from_version": engine.weights_step,
+        })
+        t0 = time.monotonic()
+        try:
+            params = load_swap_params(
+                self.checkpoint_dir, step,
+                current_params=engine.params,
+            )
+        except Exception as e:
+            return self._fail(step, "load", e)
+        load_s = time.monotonic() - t0
+        try:
+            ticket = engine.request_swap(params, step)
+        except (ValueError, RuntimeError) as e:
+            return self._fail(step, "validate", e)
+        if not ticket.done.wait(self.apply_timeout_s):
+            return self._fail(
+                step, "apply",
+                TimeoutError(
+                    f"swap not applied within {self.apply_timeout_s}s"
+                ),
+            )
+        if not ticket.ok:
+            # the engine already rolled back and emitted swap_rollback;
+            # count the failure here so replica stats carry it too
+            self.failures += 1
+            self._registry.inc("serve/swap_failures")
+            return {
+                "ok": False,
+                "stage": ticket.stage or "tick",
+                "error": ticket.error,
+                "weights_step": engine.weights_step,
+            }
+        total_s = time.monotonic() - t0
+        self._registry.emit({
+            "record": "swap_ok",
+            "version": step,
+            "load_s": load_s,
+            "total_s": total_s,
+        })
+        logger.info(
+            "hot-swap: now serving checkpoint step %d (load %.2fs, "
+            "total %.2fs)", step, load_s, total_s,
+        )
+        return {
+            "ok": True,
+            "weights_step": step,
+            "load_s": load_s,
+            "total_s": total_s,
+        }
+
+    def _fail(self, step: int, stage: str, exc: Exception) -> dict:
+        """A swap failure that never reached the serving weights: the old
+        params were never replaced, which IS the rollback (counted as one,
+        so 'a recorded rollback on every replica' holds for load-stage
+        failures too)."""
+        self.failures += 1
+        err = f"{type(exc).__name__}: {exc}"
+        self._registry.inc("serve/swap_failures")
+        self._registry.inc("serve/swap_rollbacks")
+        self._registry.emit({
+            "record": "swap_failed",
+            "version": step,
+            "stage": stage,
+            "error": err,
+        })
+        self._registry.emit({
+            "record": "swap_rollback",
+            "from_version": step,
+            "to_version": self._server.engine.weights_step,
+            "stage": stage,
+        })
+        logger.warning(
+            "hot-swap of step %d failed at %s (%s); staying on step %s",
+            step, stage, err, self._server.engine.weights_step,
+        )
+        return {
+            "ok": False,
+            "stage": stage,
+            "error": err,
+            "weights_step": self._server.engine.weights_step,
+        }
+
+    def stats(self) -> dict:
+        return {
+            "swap_attempts": self.attempts,
+            "swap_failures": self.failures,
+            "swap_blocklist": sorted(self.watcher.blocklist),
+            "swap_watching": self._polling,
+        }
+
+
+# --------------------------------------------------------------- publishing
+
+
+def publish_params_checkpoint(directory: str, step: int, params) -> str:
+    """Publish a params-only checkpoint step the hot-swap pipeline can
+    admit: orbax ``{"params": ...}`` step + the sealed integrity manifest
+    (written AFTER commit, fsynced — train/manifest.py's torn-publish
+    guarantee). This is the full publish contract in one call: what a
+    fine-tuning job's export hook (and the swap tests/bench) use."""
+    import orbax.checkpoint as ocp
+
+    from pytorch_distributed_training_tpu.train import manifest as m
+
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=None),
+    ) as mngr:
+        mngr.save(step, args=ocp.args.StandardSave({"params": params}))
+        mngr.wait_until_finished()
+    step_path = str(
+        ocp.step.find_step_path(
+            directory, ocp.step.standard_name_format(), step=step
+        )
+    )
+    m.write_manifest(
+        step_path,
+        m.build_manifest(
+            step_path, step, tree=m.tree_summary({"params": params})
+        ),
+    )
+    return step_path

@@ -59,6 +59,8 @@ from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+_UNSET = object()   # distinguishes "never sampled" from a None weights_step
+
 
 @dataclasses.dataclass
 class RouterConfig:
@@ -174,13 +176,32 @@ class Replica:
         self.requests = 0
         self.errors = 0
 
+    @property
+    def weights_step(self):
+        """The checkpoint step this replica last reported serving (None
+        until a health sample carried one)."""
+        return self.health.get("weights_step")
+
+    #: load penalty while a replica reports a weight swap in flight: the
+    #: checkpoint restore competes with its decode loop for CPU, so new
+    #: traffic prefers its peers for the duration. Deliberately MODEST
+    #: (worth ~2 queued requests): a hard steer would dogpile the
+    #: remaining replicas past their slot capacity, trading a slightly
+    #: slow answer for a queued one — the swapping replica still takes
+    #: overflow, and a 1-replica pool serves straight through its swap
+    SWAPPING_LOAD_PENALTY = 2.0
+
     def load(self) -> float:
         """Outstanding work from the latest health sample: queued requests
-        plus occupied slots (both already exported by the engine)."""
+        plus occupied slots (both already exported by the engine), plus a
+        large soft penalty while the replica is mid-swap."""
         h = self.health
-        return float(h.get("queue_depth", 0)) + float(
-            h.get("slot_occupancy", 0.0)
-        ) * float(h.get("num_slots", 1))
+        return (
+            float(h.get("queue_depth", 0))
+            + float(h.get("slot_occupancy", 0.0))
+            * float(h.get("num_slots", 1))
+            + (self.SWAPPING_LOAD_PENALTY if h.get("swapping") else 0.0)
+        )
 
     def available(self) -> bool:
         # last_ready_t gates readiness: a freshly-registered replica is NOT
@@ -200,6 +221,7 @@ class Replica:
             "breaker": self.breaker.state,
             "draining": self.draining,
             "load": self.load(),
+            "weights_step": self.weights_step,
             "requests": self.requests,
             "errors": self.errors,
             "health": self.health,
@@ -304,6 +326,8 @@ class Router:
         self.failovers = 0
         self.hedges = 0
         self.rejected = 0
+        self._last_weights: dict = {}       # replica -> last seen step
+        self._last_skew_sig: Optional[tuple] = None
 
     # -------------------------------------------------------------- health
 
@@ -383,6 +407,47 @@ class Router:
                 "replica": replica.name,
                 "draining": replica.draining,
             })
+        self._track_weights(replica)
+
+    def _track_weights(self, replica: Replica) -> None:
+        """Version-skew telemetry: record each replica's weights-step
+        change, and the pool-wide skew whenever the distinct-version set
+        shifts — the rollout window IS the span where skew > 0, which the
+        summarize_metrics swap section folds into a duration."""
+        ws = replica.weights_step
+        if self._last_weights.get(replica.name, _UNSET) != ws:
+            self._last_weights[replica.name] = ws
+            self._registry.emit({
+                "record": "router_weights",
+                "replica": replica.name,
+                "weights_step": ws,
+            })
+        sig = tuple(
+            sorted(
+                (r.name, r.weights_step) for r in self.replicas
+                if r.weights_step is not None
+            )
+        )
+        if sig != self._last_skew_sig:
+            self._last_skew_sig = sig
+            skew = self.version_skew()
+            self._registry.gauge("router/version_skew", skew)
+            self._registry.emit({
+                "record": "router_skew",
+                "weights": {
+                    r.name: r.weights_step for r in self.replicas
+                },
+                "skew": skew,
+            })
+
+    def version_skew(self) -> int:
+        """Distinct weights versions across replicas reporting one, minus
+        one — 0 means the pool is converged on a single checkpoint step."""
+        steps = {
+            r.weights_step for r in self.replicas
+            if r.weights_step is not None
+        }
+        return max(0, len(steps) - 1)
 
     # ------------------------------------------------------------- routing
 
@@ -495,11 +560,20 @@ class Router:
             self._registry.inc("router/attempt_errors")
 
         total_s = time.monotonic() - t0
+        served_by = next(
+            (r for r in self.replicas if r.name == outcome.get("replica")),
+            None,
+        )
         self._registry.emit({
             "record": "router_request",
             "id": rid,
             "status": outcome.get("status"),
             "replica": outcome.get("replica"),
+            # weights version of the serving replica (health-sample view):
+            # every routed answer stays attributable through a rollout
+            "weights_step": (
+                served_by.weights_step if served_by is not None else None
+            ),
             "attempts": attempts,
             "hedged": hedged,
             "total_s": total_s,
@@ -630,6 +704,8 @@ class Router:
             "failovers": self.failovers,
             "hedges": self.hedges,
             "rejected": self.rejected,
+            "weights": {r.name: r.weights_step for r in self.replicas},
+            "version_skew": self.version_skew(),
         }
 
 

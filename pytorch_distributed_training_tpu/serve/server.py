@@ -19,6 +19,10 @@ only touches the queue). Two transports ship with it:
   load, 503 ``draining`` while a shutdown finishes in-flight work, 503
   ``unhealthy`` when the serve loop died or its tick heartbeat went
   stale (``stall_timeout_s``). ``GET /stats`` exposes engine counters.
+  Both payloads carry ``weights_step`` — the checkpoint version this
+  replica answers from — and ``POST /swap`` (enabled when a
+  ``HotSwapManager`` is attached) swaps it live to a named step for the
+  fleet's one-replica-at-a-time rollout (serve/hotswap.py).
 
 Shutdown: ``close(drain=True)`` stops admissions and runs the engine until
 in-flight work completes; ``close(drain=False)`` cancels everything
@@ -67,6 +71,7 @@ class InferenceServer:
         registry=None,
         guards=None,
         stall_timeout_s: float = 10.0,
+        weights_step: Optional[int] = None,
     ):
         self.queue = RequestQueue(
             max_depth=queue_depth,
@@ -75,10 +80,13 @@ class InferenceServer:
         )
         self.engine = DecodeEngine(
             model, params, config, self.queue, registry=registry,
-            guards=guards,
+            guards=guards, weights_step=weights_step,
         )
         self.default_deadline_s = default_deadline_s
         self.stall_timeout_s = stall_timeout_s
+        # replica-side hot-swap executor (serve/hotswap.py), attached by
+        # the CLI when a checkpoint directory exists; enables POST /swap
+        self.hotswap = None
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -128,6 +136,8 @@ class InferenceServer:
         The draining state is visible on ``health()`` from the first line —
         a router polling ``/healthz`` pulls the replica out of rotation
         while the drain is still finishing in-flight work, not after."""
+        if self.hotswap is not None:
+            self.hotswap.close()
         self._drain_requested = True
         self.queue.close()
         self._draining = drain
@@ -182,8 +192,17 @@ class InferenceServer:
         )
         return self.queue.submit(req)
 
+    def attach_hotswap(self, manager) -> None:
+        """Wire a ``HotSwapManager`` in: enables ``POST /swap`` and folds
+        swap counters into ``stats()``. ``close()`` then owns its
+        shutdown."""
+        self.hotswap = manager
+
     def stats(self) -> dict:
-        return self.engine.stats()
+        stats = self.engine.stats()
+        if self.hotswap is not None:
+            stats.update(self.hotswap.stats())
+        return stats
 
     # ---------------------------------------------------------------- health
 
@@ -221,6 +240,15 @@ class InferenceServer:
             "slot_occupancy": self.engine.slot_occupancy(),
             "num_slots": self.engine.config.num_slots,
             "queue_capacity": self.queue.max_depth,
+            # the weights version this replica answers from — routers use
+            # it for pool version-skew telemetry during a rolling swap
+            "weights_step": self.engine.weights_step,
+            # a swap load in flight competes with the decode loop for this
+            # process's CPU: routers soft-penalize (load-away), never
+            # derotate — the swap stays zero-downtime on a 1-replica pool
+            "swapping": bool(
+                self.hotswap is not None and self.hotswap.swapping
+            ),
         }
 
 
@@ -385,6 +413,9 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 self._json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/swap":
+                self._swap()
+                return
             if self.path != "/generate":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
@@ -491,6 +522,28 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
             finally:
                 with self.server.streams_lock:
                     self.server.active_streams -= 1
+
+        def _swap(self) -> None:
+            """Admin endpoint for the fleet's rolling rollout: swap this
+            replica to a named checkpoint step, synchronously. 200 when the
+            step is serving; 409 when the swap failed and the replica kept
+            its old weights (degraded-version, still healthy — the
+            coordinator records the failure and moves on)."""
+            mgr = server.hotswap
+            if mgr is None:
+                self._json(404, {
+                    "error": "hot-swap not enabled (no --checkpoint-dir)",
+                })
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                msg = json.loads(self.rfile.read(n) or b"{}")
+                step = int(msg["step"])
+            except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+                self._json(400, {"error": f"bad swap request: {e}"})
+                return
+            out = mgr.swap_to(step)
+            self._json(200 if out.get("ok") else 409, out)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.active_streams = 0

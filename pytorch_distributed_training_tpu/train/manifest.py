@@ -16,6 +16,13 @@ finishes committing a step, next to it, and records:
 - framework versions and a wall-clock stamp — the provenance a post-mortem
   needs.
 
+The manifest doubles as the PUBLISH SIGNAL for live consumers — the
+serve-side hot-swap watcher (serve/hotswap.py) admits a step the moment
+its manifest verifies — so ``write_manifest`` enforces durability order:
+every file the manifest names (and its directory) is fsynced before the
+seal rename, and the rename itself is fsynced after; a host crash
+mid-publish can leave an unsealed step, never a seal over torn bytes.
+
 ``verify_step`` is the single checker behind ``Checkpointer.restore``'s
 fall-back-to-newest-verified-step walk and the offline
 ``scripts/verify_checkpoint.py`` validator. Verification levels: ``"size"``
@@ -99,9 +106,34 @@ def build_manifest(step_path: str, step: int, tree: dict | None = None) -> dict:
     return manifest
 
 
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory by path (directory fsync is how POSIX
+    makes a rename/creation durable, not just the bytes inside it)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def write_manifest(step_path: str, manifest: dict) -> str:
-    """Atomic write (tmp + rename): a crash mid-write leaves no manifest —
-    which verification treats as unverified, never as half-trusted."""
+    """Seal a committed step: atomic write (tmp + fsync + rename), with
+    full durability ordering. The manifest is the publish signal live
+    consumers (the serve-side hot-swap watcher) act on, so before the seal
+    rename lands, every data file it NAMES — and the directories holding
+    them — is fsynced; after the rename the step directory is fsynced too.
+    A host crash at any point therefore leaves either no manifest (the
+    step stays unverified/in-flight) or a manifest whose named bytes are
+    durably on disk — never a seal over data still sitting in the page
+    cache. A crash mid-write leaves at most a ``.tmp`` the reader
+    ignores."""
+    dirs = {step_path}
+    for rel in manifest.get("files", {}):
+        full = os.path.join(step_path, rel)
+        _fsync_path(full)
+        dirs.add(os.path.dirname(full))
+    for d in dirs:
+        _fsync_path(d)
     path = os.path.join(step_path, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -109,6 +141,7 @@ def write_manifest(step_path: str, manifest: dict) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_path(step_path)  # make the rename itself durable
     return path
 
 

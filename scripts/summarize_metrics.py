@@ -10,7 +10,10 @@ when ``serve_request`` records are present the summary carries a ``serve``
 section — per-bucket rows with request counts and p50/p95/p99 over TTFT
 (submit -> first token), TPOT (per-token decode latency) and total request
 latency, plus aggregate tokens/sec, queue-wait percentiles and
-expired/cancelled counts.
+expired/cancelled counts. Hot-swap streams (serve/hotswap.py) add a
+``swap`` section: admissions, ok/failed swaps, rollbacks, blocklisted
+steps, rollout convergence percentiles and the version-skew duration
+(from the router's ``router_skew`` spans).
 
     python scripts/summarize_metrics.py /path/to/metrics_dir
     python scripts/summarize_metrics.py /path/to/metrics.jsonl --json
@@ -111,6 +114,7 @@ def summarize(records: list[dict]) -> dict:
         "restarts": len(restarts),
         "serve": summarize_serve(records),
         "fleet": summarize_fleet(records),
+        "swap": summarize_swap(records),
         "guards": guards,
     }
 
@@ -271,6 +275,53 @@ def summarize_fleet(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_swap(records: list[dict]) -> dict | None:
+    """Fold hot-swap records (serve/hotswap.py + the engine's swap
+    protocol + the fleet's rolling rollout) into the rollout-health view:
+    admissions, successful/failed swaps, rollbacks, rollout convergence
+    times and how long the pool spent version-skewed. None when the
+    stream holds no swap records."""
+    admitted = [r for r in records if r.get("record") == "swap_admitted"]
+    oks = [r for r in records if r.get("record") == "swap_ok"]
+    fails = [r for r in records if r.get("record") == "swap_failed"]
+    rollbacks = [r for r in records if r.get("record") == "swap_rollback"]
+    rejected = [r for r in records if r.get("record") == "swap_rejected"]
+    blocked = [r for r in records if r.get("record") == "swap_blocklisted"]
+    rollouts = [r for r in records if r.get("record") == "fleet_swap"]
+    skews = [r for r in records if r.get("record") == "router_skew"]
+    if not (admitted or oks or fails or rollouts or skews):
+        return None
+    # version-skew duration: the spans between a router_skew record going
+    # >0 and the next one back at 0 (ts is stamped by the sink)
+    skew_s = 0.0
+    open_t = None
+    for r in skews:
+        ts = r.get("ts")
+        if ts is None:
+            continue
+        if (r.get("skew") or 0) > 0 and open_t is None:
+            open_t = ts
+        elif (r.get("skew") or 0) == 0 and open_t is not None:
+            skew_s += ts - open_t
+            open_t = None
+    return {
+        "admitted": len(admitted),
+        "ok": len(oks),
+        "failed": len(fails),
+        "rollbacks": len(rollbacks),
+        "rejected": len(rejected),
+        "blocklisted": sorted({r.get("step") for r in blocked}),
+        "load_s": _pcts([r.get("load_s") for r in oks]),
+        "rollouts": len(rollouts),
+        "rollouts_converged": sum(
+            1 for r in rollouts if r.get("converged")
+        ),
+        "rollout_s": _pcts([r.get("duration_s") for r in rollouts]),
+        "skew_events": len(skews),
+        "skew_s": skew_s if skews else None,
+    }
+
+
 def _fmt(v, spec=".4g") -> str:
     if v is None:
         return "-"
@@ -399,6 +450,19 @@ def render_table(summary: dict) -> str:
         if not summary["epochs"] and not serve:
             lines = []  # pure fleet stream: the fleet table IS the output
         lines.append(render_fleet_table(fleet))
+    swap = summary.get("swap")
+    if swap:
+        ro = swap.get("rollout_s") or {}
+        lines.append(
+            f"hotswap: admitted={swap['admitted']} ok={swap['ok']} "
+            f"failed={swap['failed']} rollbacks={swap['rollbacks']} "
+            f"rejected={swap['rejected']} "
+            f"blocklisted={swap['blocklisted'] or '-'} "
+            f"rollouts={swap['rollouts']}"
+            f"/{swap['rollouts_converged']} converged "
+            f"(p95 {_fmt(ro.get('p95'))}s) "
+            f"skew={_fmt(swap.get('skew_s'))}s"
+        )
     guards = summary.get("guards")
     if guards:
         bad = (

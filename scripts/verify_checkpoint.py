@@ -1,20 +1,30 @@
 #!/usr/bin/env python
-"""Offline checkpoint validator — the documented pre-resume check.
+"""Offline checkpoint validator — the documented pre-resume/publish gate.
 
 Walks every step under a checkpoint directory and verifies each against its
 integrity manifest (pytorch_distributed_training_tpu/train/manifest.py):
 file inventory by byte size, and with ``--strict`` a full sha256 re-hash
 that catches same-size corruption. Run it before resuming a long job on a
-directory you didn't just write (a copied/restored/aged one):
+directory you didn't just write (a copied/restored/aged one), or as the CI
+gate an external publisher runs before a step may enter a serving fleet's
+hot-swap rotation:
 
     python scripts/verify_checkpoint.py /ckpts/run17 --strict
+    python scripts/verify_checkpoint.py /ckpts/run17 --strict --json
 
-Exit codes:
-  0 — every step verified (what a resume will restore is trustworthy);
-  2 — some steps failed but a verified step exists (resume will FALL BACK
-      to the newest verified step — decide if that is acceptable);
-  1 — no step verified (resume would need --checkpoint-verify off, at your
-      own risk) or the directory holds no checkpoint.
+``--json`` prints one machine-readable report (per-step verdict + reason,
+the per-file sha256 digests each manifest records, and the step a restore
+or hot-swap watcher would actually use) instead of the table.
+
+Exit codes (distinct, so scripts can gate without parsing):
+  0 — every step verified (what a resume/swap will use is trustworthy);
+  2 — some steps failed but a verified step exists (resume/hot-swap will
+      FALL BACK to the newest verified step — decide if that is OK);
+  3 — corrupt: steps exist but NONE verifies (resume would need
+      --checkpoint-verify off at your own risk; a swap watcher admits
+      nothing);
+  4 — missing: the directory doesn't exist, holds no checkpoint, or the
+      requested --step is absent.
 
 Runs with JAX_PLATFORMS=cpu-safe imports only — no devices touched.
 """
@@ -22,11 +32,17 @@ Runs with JAX_PLATFORMS=cpu-safe imports only — no devices touched.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
+
+EXIT_VERIFIED = 0
+EXIT_PARTIAL = 2
+EXIT_CORRUPT = 3
+EXIT_MISSING = 4
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -39,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "catches same-size corruption; costs a full read")
     p.add_argument("--quiet", action="store_true",
                    help="exit code only, no per-step report")
+    p.add_argument("--json", action="store_true",
+                   help="print one JSON report (per-step verdict + manifest "
+                        "digests) instead of the table — for publishers and "
+                        "CI gates")
     return p
 
 
@@ -49,17 +69,30 @@ def main(argv=None) -> int:
     from pytorch_distributed_training_tpu.train import manifest
 
     directory = os.path.abspath(args.directory)
-    if not os.path.isdir(directory):
-        print(f"{directory}: not a directory", file=sys.stderr)
-        return 1
     level = "digest" if args.strict else "size"
+
+    def report_missing(message: str) -> int:
+        if args.json:
+            print(json.dumps({
+                "directory": directory,
+                "level": level,
+                "verdict": "missing",
+                "error": message,
+                "steps": [],
+            }))
+        else:
+            print(message, file=sys.stderr)
+        return EXIT_MISSING
+
+    if not os.path.isdir(directory):
+        return report_missing(f"{directory}: not a directory")
     with ocp.CheckpointManager(directory) as mngr:
         steps = sorted(mngr.all_steps())
         if args.step is not None:
             if args.step not in steps:
-                print(f"step {args.step} not found (have {steps})",
-                      file=sys.stderr)
-                return 1
+                return report_missing(
+                    f"step {args.step} not found (have {steps})"
+                )
             steps = [args.step]
         results = {}
         for step in steps:
@@ -68,23 +101,51 @@ def main(argv=None) -> int:
                     directory, ocp.step.standard_name_format(), step=step
                 )
             )
-            results[step] = manifest.verify_step(path, level=level)
+            ok, reason = manifest.verify_step(path, level=level)
+            m = manifest.read_manifest(path) or {}
+            results[step] = {
+                "step": step,
+                "ok": ok,
+                "reason": reason,
+                # the digests the manifest CLAIMS (what a publisher signs
+                # off on) — recomputation is what verify_step just did
+                "digests": {
+                    rel: info.get("sha256")
+                    for rel, info in (m.get("files") or {}).items()
+                },
+            }
     if not results:
-        print(f"no checkpoint under {directory}", file=sys.stderr)
-        return 1
-    verified = [s for s, (ok, _) in results.items() if ok]
-    if not args.quiet:
-        for step, (ok, reason) in sorted(results.items()):
-            print(f"step {step:>8}: {'OK' if ok else 'FAIL'} ({reason})")
-        newest = max(verified) if verified else None
+        return report_missing(f"no checkpoint under {directory}")
+    verified = [s for s, r in results.items() if r["ok"]]
+    newest = max(verified) if verified else None
+    if len(verified) == len(results):
+        verdict, code = "verified", EXIT_VERIFIED
+    elif verified:
+        verdict, code = "partial", EXIT_PARTIAL
+    else:
+        verdict, code = "corrupt", EXIT_CORRUPT
+    if args.json:
+        print(json.dumps({
+            "directory": directory,
+            "level": level,
+            "verdict": verdict,
+            "verified": len(verified),
+            "total": len(results),
+            "verified_latest": newest,
+            "steps": [results[s] for s in sorted(results)],
+        }, indent=1))
+    elif not args.quiet:
+        for step, r in sorted(results.items()):
+            print(
+                f"step {step:>8}: {'OK' if r['ok'] else 'FAIL'} "
+                f"({r['reason']})"
+            )
         print(
             f"{len(verified)}/{len(results)} step(s) verified at level "
             f"{level!r}; restore would use: "
             f"{newest if newest is not None else 'NOTHING — no verified step'}"
         )
-    if len(verified) == len(results):
-        return 0
-    return 2 if verified else 1
+    return code
 
 
 if __name__ == "__main__":

@@ -606,6 +606,9 @@ def _load_verifier():
 
 
 def test_verify_checkpoint_script_exit_codes(mini_run, tmp_path, capsys):
+    """Distinct exit codes so publishers/CI gate without parsing:
+    0 verified / 2 partial (fallback exists) / 3 corrupt (nothing
+    verifies) / 4 missing (no directory, no checkpoint, no such step)."""
     vc = _load_verifier()
     _, d = mini_run
     work = str(tmp_path / "ckpt")
@@ -622,14 +625,89 @@ def test_verify_checkpoint_script_exit_codes(mini_run, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "restore would use: 2" in out
     assert vc.main([work, "--strict", "--step", "2"]) == 0  # single step
+    assert vc.main([work, "--step", "7"]) == 4  # no such step
 
     corrupt_step_dir(_step_path(work, 2))
-    assert vc.main([work, "--strict", "--quiet"]) == 1  # nothing left
+    assert vc.main([work, "--strict", "--quiet"]) == 3  # corrupt: none left
 
     empty = tmp_path / "empty"
     empty.mkdir()
-    assert vc.main([str(empty)]) == 1
-    assert vc.main([str(tmp_path / "missing")]) == 1
+    assert vc.main([str(empty)]) == 4
+    assert vc.main([str(tmp_path / "missing")]) == 4
+
+
+def test_verify_checkpoint_script_json_report(mini_run, tmp_path, capsys):
+    """--json: per-step verdicts + the per-file digests each manifest
+    records — what an external publisher signs off on before a step may
+    enter a serving fleet's hot-swap rotation."""
+    vc = _load_verifier()
+    _, d = mini_run
+    work = str(tmp_path / "ckpt")
+    shutil.copytree(d, work)
+
+    assert vc.main([work, "--strict", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "verified"
+    assert report["verified"] == report["total"] == 2
+    assert report["verified_latest"] == 4
+    by_step = {s["step"]: s for s in report["steps"]}
+    assert by_step[4]["ok"] is True and by_step[4]["reason"] == "ok"
+    digests = by_step[4]["digests"]
+    assert digests and all(
+        isinstance(v, str) and len(v) == 64 for v in digests.values()
+    )
+
+    corrupt_step_dir(_step_path(work, 4))
+    assert vc.main([work, "--strict", "--json"]) == 2
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "partial"
+    assert report["verified_latest"] == 2
+    by_step = {s["step"]: s for s in report["steps"]}
+    assert by_step[4]["ok"] is False
+    assert "digest mismatch" in by_step[4]["reason"]
+
+    assert vc.main([str(tmp_path / "missing"), "--json"]) == 4
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "missing" and report["steps"] == []
+
+
+def test_write_manifest_fsyncs_named_files_before_seal(
+    tmp_path, monkeypatch
+):
+    """Torn-publish durability: before the seal rename lands, every data
+    file the manifest names (and the directories holding them) must be
+    fsynced, and the rename itself fsynced after — a host crash
+    mid-publish can never leave a manifest naming arrays that were not
+    durably written (the hot-swap watcher acts on the seal alone)."""
+    step_path = tmp_path / "7"
+    sub = step_path / "arrays"
+    sub.mkdir(parents=True)
+    (step_path / "meta.json").write_bytes(b"{}")
+    (sub / "w.bin").write_bytes(b"weights")
+
+    synced = []
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        synced.append(os.readlink(f"/proc/self/fd/{fd}"))
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    m = manifest.build_manifest(str(step_path), 7)
+    manifest.write_manifest(str(step_path), m)
+
+    assert set(m["files"]) == {"meta.json", os.path.join("arrays", "w.bin")}
+    # every named data file was fsynced...
+    for rel in m["files"]:
+        assert str(step_path / rel) in synced
+    # ...and so were the directories (file creation durability) and the
+    # step dir again after the rename (seal durability); the manifest tmp
+    # itself is the deleted-on-rename entry
+    assert synced.count(str(step_path)) >= 2
+    assert str(sub) in synced
+    assert manifest.verify_step(str(step_path), level="digest") == (
+        True, "ok",
+    )
 
 
 # --------------------------------------------------- end-to-end recovery (IT)

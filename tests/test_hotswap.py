@@ -1,0 +1,789 @@
+"""Live checkpoint hot-swap tests (serve/hotswap.py + the engine's swap
+protocol + the fleet's rolling rollout).
+
+Three tiers, all CPU and tier-1 (``-m swap`` selects just this file):
+
+- watcher unit tests against hand-built step directories (manifest-sealed
+  fake steps — no orbax, no model): admission order, monotonicity,
+  partial-publish tolerance, re-publish rejection, blocklisting, clean
+  shutdown with a poll in flight;
+- in-process engine/server tests (gpt2-tiny): a swap is token-identical
+  to serving the new weights from scratch, clean under strict guards (no
+  retrace, no implicit transfer), applied between ticks with in-flight
+  requests finishing, rolled back when the first post-swap tick fails,
+  and rejected outright for shape-mismatched trees; the ``POST /swap``
+  endpoint drives the same path over HTTP;
+- THE chaos drill: a 2-replica fleet under closed-loop load, a corrupt
+  checkpoint published mid-serve (``PDT_TPU_FAULT=corrupt_ckpt_swap``) —
+  zero failed requests, a recorded rollback on every replica, the step
+  blocklisted, and a subsequently published good step serving on ALL
+  replicas (router skew 0) with no replica restart and strict guards
+  clean.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.serve.hotswap import (
+    CheckpointWatcher,
+    manifest_digest,
+    scan_step_dirs,
+)
+from pytorch_distributed_training_tpu.serve.server import wait_until
+from pytorch_distributed_training_tpu.train import manifest
+
+pytestmark = [pytest.mark.serve, pytest.mark.swap]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        with self._lock:
+            self.records.append(rec)
+
+    def flush(self, **kw):
+        pass
+
+    def of(self, kind):
+        with self._lock:
+            return [r for r in self.records if r.get("record") == kind]
+
+
+def _registry():
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    sink = ListSink()
+    reg.attach_sink(sink)
+    return reg, sink
+
+
+# =====================================================================
+# watcher: hand-built manifest-sealed steps, no orbax
+# =====================================================================
+
+
+def _publish_fake(directory, step: int, payload: bytes) -> str:
+    """A minimal sealed step: one data file + a real integrity manifest
+    (the same build/write path the checkpointer uses)."""
+    path = os.path.join(str(directory), str(step))
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "weights.bin"), "wb") as f:
+        f.write(payload)
+    manifest.write_manifest(path, manifest.build_manifest(path, step))
+    return path
+
+
+class Applier:
+    """Recording apply_fn whose verdict per step is scriptable."""
+
+    def __init__(self, fail_steps=()):
+        self.calls = []
+        self.fail_steps = set(fail_steps)
+
+    def __call__(self, step: int) -> bool:
+        self.calls.append(step)
+        return step not in self.fail_steps
+
+
+def _watcher(directory, apply_fn, reg, **kw):
+    kw.setdefault("verify_level", "digest")
+    kw.setdefault("start_step", 0)
+    return CheckpointWatcher(
+        str(directory), apply_fn, registry=reg, **kw
+    )
+
+
+def test_scan_step_dirs_ignores_non_steps(tmp_path):
+    _publish_fake(tmp_path, 3, b"three")
+    _publish_fake(tmp_path, 10, b"ten")
+    os.makedirs(tmp_path / "tmp_orbax_thing")
+    (tmp_path / "metrics.jsonl").write_text("{}\n")
+    assert scan_step_dirs(str(tmp_path)) == [3, 10]
+    assert scan_step_dirs(str(tmp_path / "missing")) == []
+
+
+def test_watcher_admits_newest_verified_once(tmp_path):
+    reg, sink = _registry()
+    apply = Applier()
+    w = _watcher(tmp_path, apply, reg)
+    assert w.poll_once() is None        # empty dir: nothing to admit
+    _publish_fake(tmp_path, 1, b"v1")
+    _publish_fake(tmp_path, 2, b"v2")
+    assert w.poll_once() == 2           # newest verified wins, 1 skipped
+    assert apply.calls == [2]
+    assert w.current_step == 2
+    assert w.poll_once() is None        # never admitted twice
+    assert apply.calls == [2]
+    _publish_fake(tmp_path, 5, b"v5")
+    assert w.poll_once() == 5
+    assert [r["step"] for r in sink.of("swap_admitted")] == [2, 5]
+
+
+def test_watcher_baseline_without_applying(tmp_path):
+    """start_step=None: the first poll records what is already on disk as
+    the serving baseline — the caller booted from it, re-applying would be
+    a spurious swap."""
+    reg, sink = _registry()
+    _publish_fake(tmp_path, 4, b"v4")
+    apply = Applier()
+    w = _watcher(tmp_path, apply, reg, start_step=None)
+    assert w.poll_once() is None
+    assert w.current_step == 4 and apply.calls == []
+    assert sink.of("swap_baseline")[0]["step"] == 4
+    _publish_fake(tmp_path, 6, b"v6")
+    assert w.poll_once() == 6
+
+
+def test_watcher_skips_partial_publish_then_admits(tmp_path):
+    """A step directory appearing mid-poll without its manifest seal (or
+    failing verification) is 'in flight', not poisoned: skipped without
+    blocklisting, admitted once the seal lands intact."""
+    reg, _sink = _registry()
+    apply = Applier()
+    w = _watcher(tmp_path, apply, reg)
+    path = os.path.join(str(tmp_path), "3")
+    os.makedirs(path)
+    with open(os.path.join(path, "weights.bin"), "wb") as f:
+        f.write(b"partial")
+    assert w.poll_once() is None        # no manifest yet
+    assert 3 not in w.blocklist
+    manifest.write_manifest(path, manifest.build_manifest(path, 3))
+    # seal present but bytes torn (size intact, content flipped): still
+    # not admitted at digest level, still not blocklisted
+    with open(os.path.join(path, "weights.bin"), "r+b") as f:
+        f.write(b"PARTIAL")
+    assert w.poll_once() is None
+    assert 3 not in w.blocklist
+    with open(os.path.join(path, "weights.bin"), "r+b") as f:
+        f.write(b"partial")             # publisher finishes for real
+    assert w.poll_once() == 3
+    assert apply.calls == [3]
+
+
+def test_watcher_rejects_out_of_order_older_step(tmp_path):
+    reg, sink = _registry()
+    apply = Applier()
+    _publish_fake(tmp_path, 5, b"v5")
+    w = _watcher(tmp_path, apply, reg)
+    assert w.poll_once() == 5
+    _publish_fake(tmp_path, 3, b"v3-late")  # published out of order
+    assert w.poll_once() is None
+    assert apply.calls == [5]               # never applied, never regressed
+    rejects = sink.of("swap_rejected")
+    assert [r["step"] for r in rejects] == [3]
+    assert "older" in rejects[0]["reason"]
+    assert w.poll_once() is None            # rejected once, not per poll
+    assert [r["step"] for r in sink.of("swap_rejected")] == [3]
+
+
+def test_watcher_ignores_preexisting_retention_history(tmp_path):
+    """Older steps already in the directory at startup (keep=N retention)
+    are history, not an out-of-order publish: no rejection records, no
+    applies — and the newest verified one is still admitted normally."""
+    reg, sink = _registry()
+    _publish_fake(tmp_path, 2, b"v2")
+    _publish_fake(tmp_path, 4, b"v4")
+    _publish_fake(tmp_path, 6, b"v6")
+    apply = Applier()
+    w = _watcher(tmp_path, apply, reg, start_step=4)  # booted from 4
+    assert w.poll_once() == 6
+    assert w.poll_once() is None
+    assert apply.calls == [6]
+    assert sink.of("swap_rejected") == []   # step 2 is history, not stale
+
+
+def test_watcher_rejects_republished_step_with_different_digests(tmp_path):
+    reg, sink = _registry()
+    apply = Applier()
+    w = _watcher(tmp_path, apply, reg)
+    _publish_fake(tmp_path, 2, b"sealed-once")
+    assert w.poll_once() == 2
+    # a publisher rewrites the SAME step with different bytes + manifest —
+    # sealed steps are immutable, this must be rejected and logged
+    _publish_fake(tmp_path, 2, b"sealed-TWICE-different")
+    assert w.poll_once() is None
+    assert apply.calls == [2]
+    rejects = sink.of("swap_rejected")
+    assert any(
+        r["step"] == 2 and "republished" in r["reason"] for r in rejects
+    )
+    assert 2 in w.blocklist
+    assert w.current_step == 2
+
+
+def test_watcher_blocklists_failed_apply_and_recovers_on_next_step(tmp_path):
+    reg, sink = _registry()
+    apply = Applier(fail_steps={2})
+    w = _watcher(tmp_path, apply, reg)
+    _publish_fake(tmp_path, 2, b"poisoned")
+    assert w.poll_once() is None
+    assert apply.calls == [2]
+    assert 2 in w.blocklist
+    assert sink.of("swap_blocklisted")[0]["step"] == 2
+    assert w.poll_once() is None            # no poisoned-step retry loop
+    assert apply.calls == [2]
+    _publish_fake(tmp_path, 3, b"good")
+    assert w.poll_once() == 3               # recovery = the next good step
+    assert apply.calls == [2, 3]
+
+
+def test_watcher_manifest_digest_distinguishes_content(tmp_path):
+    a = _publish_fake(tmp_path, 1, b"content-a")
+    da = manifest_digest(manifest.read_manifest(a))
+    b = _publish_fake(tmp_path, 2, b"content-b")
+    db = manifest_digest(manifest.read_manifest(b))
+    assert da != db
+    assert da == manifest_digest(manifest.read_manifest(a))
+
+
+def test_watcher_clean_shutdown_with_poll_in_flight(tmp_path):
+    """close() while an apply is running: the in-flight poll finishes (a
+    swap is never torn by shutdown), the thread exits, no further polls."""
+    reg, _sink = _registry()
+    started = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def slow_apply(step):
+        calls.append(step)
+        started.set()
+        release.wait(5.0)
+        return True
+
+    w = _watcher(tmp_path, slow_apply, reg, poll_interval_s=0.01)
+    _publish_fake(tmp_path, 1, b"v1")
+    w.start()
+    assert started.wait(10.0)
+    closer = threading.Thread(target=w.close, daemon=True)
+    closer.start()
+    time.sleep(0.05)
+    release.set()                       # let the in-flight apply finish
+    closer.join(10.0)
+    assert not closer.is_alive()
+    assert calls == [1]
+    assert w.current_step == 1          # the in-flight swap completed
+    time.sleep(0.05)
+    assert w.polls >= 1 and calls == [1]    # and nothing polled after
+
+
+def test_swap_fault_spec_parsing_and_fleet_routing():
+    from pytorch_distributed_training_tpu.faults.inject import FaultPlan
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        split_fault_specs,
+    )
+
+    plan = FaultPlan.parse(
+        "corrupt_ckpt_swap:2,swap_crash:0,swap_slow:3:0.5"
+    )
+    kinds = [(s.kind, s.step, s.factor) for s in plan.specs]
+    assert kinds == [
+        ("corrupt_ckpt_swap", 2, 1.0),
+        ("swap_crash", 0, 1.0),         # checkpoint step 0 is legal
+        ("swap_slow", 3, 0.5),
+    ]
+    assert FaultPlan.parse("swap_slow:3").specs[0].factor == 2.0
+    for bad in ("corrupt_ckpt_swap:-1", "swap_crash:2:9", "swap_slow:1:0"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+    # swap kinds are serve-scoped: routed per replica by @rank
+    routed = split_fault_specs("corrupt_ckpt_swap:2,corrupt_ckpt_swap:2@1")
+    assert routed == {0: "corrupt_ckpt_swap:2", 1: "corrupt_ckpt_swap:2"}
+
+
+# =====================================================================
+# engine + server: the swap itself (gpt2-tiny, in process)
+# =====================================================================
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(cfg)
+
+    def params_for(seed):
+        return model.init(
+            jax.random.key(seed), jnp.ones((2, 16), jnp.int32)
+        )["params"]
+
+    return model, params_for(0), params_for(7)
+
+
+def _server(lm, reg=None, *, guards_mode="strict", **kw):
+    from pytorch_distributed_training_tpu.analysis.guards import GuardSet
+    from pytorch_distributed_training_tpu.serve import (
+        EngineConfig,
+        InferenceServer,
+    )
+
+    model, pA, _pB = lm
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("weights_step", 1)
+    return InferenceServer(
+        model, pA,
+        EngineConfig(num_slots=2, prompt_buckets=(8,), max_new_tokens=32),
+        registry=reg,
+        guards=GuardSet(mode=guards_mode, registry=reg),
+        **kw,
+    )
+
+
+def _prompt(model, n=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, model.config.vocab_size, n).astype(np.int32)
+
+
+def _one_shot(model, params, prompt, n):
+    from pytorch_distributed_training_tpu.models.generate import generate
+
+    out = np.asarray(generate(model, params, prompt[None],
+                              max_new_tokens=n))
+    return list(out[0, len(prompt):])
+
+
+def test_swap_is_token_identical_and_guard_clean(lm):
+    """The acceptance core: after a live swap, greedy decode is token-
+    identical to serving the new weights from scratch; the swap neither
+    retraces nor implicitly transfers (PDT_TPU_GUARDS=strict clean); the
+    KV cache survives (an in-flight request keeps streaming through the
+    swap); serve_request telemetry attributes every answer to a weights
+    version."""
+    model, pA, pB = lm
+    reg, sink = _registry()
+    server = _server(lm, reg).start()
+    try:
+        prompt = _prompt(model)
+        r1 = server.submit(prompt, max_new_tokens=6)
+        assert wait_until(r1.done.is_set, timeout=120)
+        assert list(r1.tokens) == _one_shot(model, pA, prompt, 6)
+
+        # swap mid-flight: a long request keeps streaming across the swap
+        # boundary (slots continue on the new weights — the documented
+        # contract) and terminates normally
+        r2 = server.submit(_prompt(model, seed=3), max_new_tokens=24)
+        assert wait_until(lambda: len(r2.tokens) >= 3, timeout=120)
+        ticket = server.engine.request_swap(pB, 2)
+        assert ticket.done.wait(30) and ticket.ok
+        assert wait_until(r2.done.is_set, timeout=120)
+        assert r2.status == "done" and len(r2.tokens) == 24
+
+        # post-swap requests serve the NEW weights, token-identically
+        r3 = server.submit(prompt, max_new_tokens=6)
+        assert wait_until(r3.done.is_set, timeout=120)
+        assert list(r3.tokens) == _one_shot(model, pB, prompt, 6)
+        assert list(r3.tokens) != list(r1.tokens)   # the weights moved
+
+        stats = server.stats()
+        assert stats["weights_step"] == 2
+        assert stats["swaps"] == 1 and stats["swap_rollbacks"] == 0
+        # strict guards stayed clean: same shapes -> no retrace; placed
+        # arrays -> no implicit transfer
+        assert stats["guard_recompiles"] == 0
+        assert stats["guard_implicit_transfers"] == 0
+        assert server.health()["weights_step"] == 2
+
+        # every response is attributable to the weights that produced it
+        by_id = {r["id"]: r for r in sink.of("serve_request")}
+        assert by_id[r1.id]["weights_step"] == 1
+        assert by_id[r3.id]["weights_step"] == 2
+        assert sink.of("swap_applied")[0]["version"] == 2
+        assert sink.of("swap_committed")[0]["version"] == 2
+    finally:
+        server.close(drain=False)
+
+
+def test_swap_rejects_shape_mismatch_without_touching_weights(lm):
+    import jax
+
+    model, pA, pB = lm
+    reg, _sink = _registry()
+    server = _server(lm, reg)
+    engine = server.engine
+    bad = jax.tree.map(lambda x: x[..., :1], pB)    # every leaf truncated
+    with pytest.raises(ValueError, match="shape/dtype mismatch"):
+        engine.request_swap(bad, 2)
+    with pytest.raises(ValueError, match="structure"):
+        engine.request_swap({"nope": pB}, 2)
+    assert engine.weights_step == 1 and engine._pending_swap is None
+    server.close(drain=False)
+
+
+def test_swap_trial_rollback_on_first_post_swap_tick_failure(lm):
+    """Old params stay alive until the first post-swap tick completes: a
+    failing trial tick rolls back to them, records the failure, and the
+    engine keeps serving the OLD weights — a bad swap degrades the
+    weights version, never availability."""
+    model, pA, pB = lm
+    reg, sink = _registry()
+    server = _server(lm, reg)
+    engine = server.engine
+    prompt = _prompt(model)
+
+    boom = {"armed": False}
+    real_expire = server.queue.expire_overdue
+
+    def expire(*a, **kw):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected trial-tick failure")
+        return real_expire(*a, **kw)
+
+    server.queue.expire_overdue = expire
+    ticket = engine.request_swap(pB, 2)
+    boom["armed"] = True
+    assert engine.tick() is True        # swallowed: the loop must survive
+    assert ticket.done.is_set() and ticket.ok is False
+    assert ticket.stage == "tick"
+    assert engine.weights_step == 1     # rolled back
+    assert engine.swap_rollbacks == 1 and engine.swaps == 0
+    fails = sink.of("swap_failed")
+    assert fails and fails[0]["stage"] == "tick"
+    rb = sink.of("swap_rollback")
+    assert rb and rb[0] == {
+        **rb[0], "from_version": 2, "to_version": 1,
+    }
+    # still serving the OLD weights, token-identically
+    req = server.submit(prompt, max_new_tokens=4)
+    while not req.done.is_set():
+        engine.tick()
+    assert list(req.tokens) == _one_shot(model, pA, prompt, 4)
+    server.close(drain=False)
+
+
+def test_hotswap_manager_and_http_swap_endpoint(lm, tmp_path):
+    """The replica-side contract over HTTP: POST /swap to a published,
+    verified step serves it (200 + weights_step everywhere); a missing or
+    corrupt-at-load step answers 409, keeps the old weights serving, and
+    records swap_failed + a rollback."""
+    from pytorch_distributed_training_tpu.data.bpe import ByteTokenizer
+    from pytorch_distributed_training_tpu.faults.inject import (
+        FaultPlan,
+        set_plan,
+    )
+    from pytorch_distributed_training_tpu.serve import (
+        HotSwapManager,
+        make_http_server,
+        publish_params_checkpoint,
+    )
+
+    model, pA, pB = lm
+    ckpt_dir = str(tmp_path / "ckpt")
+    publish_params_checkpoint(ckpt_dir, 1, pA)
+    reg, sink = _registry()
+    server = _server(lm, reg).start()
+    server.attach_hotswap(
+        HotSwapManager(server, ckpt_dir, registry=reg, start_step=1)
+    )
+    httpd = make_http_server(server, ByteTokenizer())
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def post_swap(step):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/swap", body=json.dumps({"step": step}))
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        return resp.status, payload
+
+    def healthz():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        return payload
+
+    try:
+        status, out = post_swap(9)              # never published
+        assert status == 409 and out["ok"] is False
+        assert out["stage"] == "load" and out["weights_step"] == 1
+
+        publish_params_checkpoint(ckpt_dir, 2, pB)
+        status, out = post_swap(2)
+        assert status == 200 and out["ok"] is True
+        assert out["weights_step"] == 2 and out["load_s"] > 0
+        assert healthz()["weights_step"] == 2
+        status, out = post_swap(2)              # idempotent no-op
+        assert status == 200 and out.get("noop") is True
+
+        # corrupt-at-load (the injected stand-in for a torn array that
+        # verification missed): 409, old weights keep serving
+        publish_params_checkpoint(ckpt_dir, 3, pA)
+        prev = set_plan(FaultPlan.parse("corrupt_ckpt_swap:3"))
+        try:
+            status, out = post_swap(3)
+        finally:
+            set_plan(prev)
+        assert status == 409 and out["ok"] is False
+        assert "corrupt" in out["error"]
+        assert out["weights_step"] == 2
+        stats = server.stats()
+        assert stats["swap_failures"] == 2 and stats["swap_attempts"] >= 3
+        assert [r["version"] for r in sink.of("swap_failed")] == [9, 3]
+        assert sink.of("swap_rollback")     # rollback recorded
+        req = server.submit(_prompt(model), max_new_tokens=4)
+        assert wait_until(req.done.is_set, timeout=120)
+        assert req.status == "done"         # still serving, on step 2
+    finally:
+        httpd.shutdown()
+        server.close(drain=False)
+
+
+def test_hotswap_manager_watcher_polls_new_steps(lm, tmp_path):
+    """Standalone-replica mode: --hotswap-poll-s semantics — the manager's
+    own watcher picks a newly published verified step up with no external
+    driver."""
+    from pytorch_distributed_training_tpu.serve import (
+        HotSwapManager,
+        publish_params_checkpoint,
+    )
+
+    model, pA, pB = lm
+    ckpt_dir = str(tmp_path / "ckpt")
+    publish_params_checkpoint(ckpt_dir, 1, pA)
+    reg, _sink = _registry()
+    server = _server(lm, reg).start()
+    server.attach_hotswap(
+        HotSwapManager(
+            server, ckpt_dir, poll_interval_s=0.05, registry=reg,
+            start_step=1,
+        ).start()
+    )
+    try:
+        publish_params_checkpoint(ckpt_dir, 2, pB)
+        assert wait_until(
+            lambda: server.engine.weights_step == 2, timeout=60
+        )
+        assert server.stats()["swaps"] == 1
+    finally:
+        server.close(drain=False)
+
+
+# =====================================================================
+# THE chaos drill: corrupt publish into a loaded 2-replica fleet
+# =====================================================================
+
+
+def _post_generate(port, prompt, max_new, rid, timeout=120):
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request(
+            "POST", "/generate",
+            body=json.dumps({"prompt": prompt, "max_new_tokens": max_new}),
+            headers={"X-Request-Id": rid},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            conn.close()
+            return {"outcome": "rejected", "status": resp.status}
+        events = [json.loads(l) for l in resp.read().decode().splitlines()]
+        conn.close()
+        last = events[-1] if events else {}
+        return {
+            "outcome": "done" if last.get("event") == "done" else "bad",
+            "events": events,
+        }
+    except Exception as e:          # pragma: no cover - drill diagnostics
+        return {"outcome": "exception", "error": repr(e)}
+
+
+def _replica_stats(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/stats")
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    return payload
+
+
+@pytest.mark.chaos
+def test_fleet_corrupt_swap_drill_zero_failures_then_converges(
+    lm, tmp_path
+):
+    """THE acceptance drill: 2 replicas serve a closed loop while a
+    corrupt checkpoint step is published — zero request failures, every
+    replica records the failed swap + rollback and stays on its old
+    weights, the watcher blocklists the step; a subsequently published
+    good step then rolls out to BOTH replicas (router skew 0) with no
+    replica restart and strict guards clean on both."""
+    from pytorch_distributed_training_tpu.serve import (
+        publish_params_checkpoint,
+    )
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+
+    model, pA, pB = lm
+    ckpt_dir = str(tmp_path / "ckpt")
+    publish_params_checkpoint(ckpt_dir, 1, pA)
+
+    reg, sink = _registry()
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "2",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "64",
+                "--queue-depth", "16", "--stall-timeout-s", "10",
+                "--checkpoint-dir", ckpt_dir,
+            ),
+            # both replicas reject the load of step 2; strict guards prove
+            # the swap path neither retraces nor implicitly transfers
+            fault_env={0: "corrupt_ckpt_swap:2", 1: "corrupt_ckpt_swap:2"},
+            replica_env={"PDT_TPU_GUARDS": "strict"},
+            max_restarts=1,
+            backoff_s=0.2,
+            drain_timeout_s=20.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, health_timeout_s=1.0,
+            breaker_threshold=3, breaker_cooldown_s=0.5,
+            retry_backoff_s=0.02, retry_backoff_max_s=0.1,
+            ttfb_timeout_s=60.0,
+        ),
+        registry=reg,
+    ).start()
+    httpd = None
+    try:
+        assert fleet.wait_ready(timeout=120), fleet.stats()
+        fleet.enable_hotswap(ckpt_dir, poll_interval_s=0.1)
+        httpd = make_router_http_server(fleet.router)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        def wave(tag, n=6):
+            results = [None] * n
+            threads = []
+            for i in range(n):
+                def run(i=i):
+                    results[i] = _post_generate(
+                        port, f"{tag} request {i}", 8, f"{tag}-{i}"
+                    )
+                t = threading.Thread(target=run, daemon=True)
+                threads.append(t)
+                t.start()
+            return results, threads
+
+        # corrupt step 2 publishes while wave A is in flight
+        results_a, threads_a = wave("corrupt")
+        publish_params_checkpoint(ckpt_dir, 2, pB)
+        for t in threads_a:
+            t.join(180)
+        assert all(not t.is_alive() for t in threads_a)
+
+        # both replicas refused the swap; the rollout recorded it and the
+        # watcher blocklisted the poisoned step
+        assert wait_until(
+            lambda: any(
+                r["step"] == 2 for r in sink.of("fleet_swap")
+            ),
+            timeout=60,
+        ), sink.records[-5:]
+        rollout2 = [r for r in sink.of("fleet_swap") if r["step"] == 2][0]
+        assert rollout2["failed"] == 2 and rollout2["ok"] == 0
+        assert rollout2["converged"] is False
+        assert wait_until(
+            lambda: 2 in fleet.hotswap.watcher.blocklist, timeout=30
+        )
+
+        # ZERO request failures while the corrupt publish was rejected
+        assert [r["outcome"] for r in results_a] == ["done"] * 6, results_a
+
+        # every replica recorded the failed swap + rollback and kept its
+        # old weights serving (degraded-version, still healthy)
+        for replica in fleet.replicas:
+            st = _replica_stats(replica.port)
+            assert st["swap_failures"] >= 1, st
+            assert st["weights_step"] == 1
+        assert fleet.router.stats()["weights"] == {"r0": 1, "r1": 1}
+
+        # a good step lands: the fleet converges on it — all replicas,
+        # skew zero, NO replica restarted, no retrace/transfer violation
+        publish_params_checkpoint(ckpt_dir, 3, pB)
+        assert wait_until(
+            lambda: fleet.router.stats()["weights"] == {"r0": 3, "r1": 3}
+            and fleet.router.stats()["version_skew"] == 0,
+            timeout=120,
+        ), fleet.router.stats()
+        rollout3 = [r for r in sink.of("fleet_swap") if r["step"] == 3][0]
+        assert rollout3["ok"] == 2 and rollout3["converged"] is True
+        for replica in fleet.replicas:
+            d = replica.describe()
+            assert d["spawns"] == 1 and d["restarts_used"] == 0, d
+            st = _replica_stats(replica.port)
+            assert st["weights_step"] == 3
+            assert st["guard_mode"] == "strict"
+            assert st["guard_recompiles"] == 0
+            assert st["guard_implicit_transfers"] == 0
+            assert st["swaps"] >= 1 and st["swap_rollbacks"] == 0
+
+        # the converged pool still answers, on the new weights
+        results_b, threads_b = wave("post", n=4)
+        for t in threads_b:
+            t.join(180)
+        assert [r["outcome"] for r in results_b] == ["done"] * 4, results_b
+        reqs = [
+            r for r in sink.of("router_request")
+            if r["id"].startswith("post-")
+        ]
+        assert reqs and all(r["weights_step"] == 3 for r in reqs)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        fleet.stop(drain=False)
+
+    # the drill's stream folds into the summarize_metrics swap section
+    import subprocess
+    import sys
+
+    stream = str(tmp_path / "metrics.jsonl")
+    with open(stream, "w") as f:
+        for r in sink.records:
+            f.write(json.dumps(r) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", stream, "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout)
+    swap = summary["swap"]
+    assert swap["admitted"] >= 2
+    assert swap["rollouts"] == 2 and swap["rollouts_converged"] == 1
+    assert swap["blocklisted"] == [2]
+    assert swap["skew_events"] >= 1
+    table = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", stream],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert table.returncode == 0 and "hotswap:" in table.stdout

@@ -84,3 +84,41 @@ def test_fleet_bench_availability_under_replica_kill(tmp_path):
     assert result["recovery"]["post_recovery_request"] == "done"
     # latency artifact present for the dashboard delta
     assert base["p99_s"] and chaos["p99_s"] and result["p99_delta"]
+
+
+@pytest.mark.swap
+def test_swap_bench_p99_delta_and_convergence(tmp_path):
+    """bench.py --swap: a new checkpoint step published + rolled across a
+    2-replica pool mid-load. The rollout must cost at most a modest tail
+    penalty (p99 delta <= 1.5x the healthy baseline), fail ZERO requests,
+    converge the whole pool (router skew 0) without a replica restart,
+    and carry the publish-to-convergence time the rollout dashboards
+    track."""
+    out = tmp_path / "BENCH_swap.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--swap", "--swap-out", str(out),
+        ],
+        capture_output=True, text=True, timeout=1200, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(out.read_text())
+
+    base, swap = result["baseline"], result["swap"]
+    assert base["done"] == base["requests"] and base["failed"] == 0
+    assert swap["done"] == swap["requests"] and swap["failed"] == 0
+    assert result["failed_requests"] == 0
+
+    # the acceptance gate: swapping under load costs <= 1.5x p99
+    assert result["p99_delta"] is not None
+    assert result["p99_delta"] <= 1.5, result
+
+    # the pool converged on the new step with no restart
+    assert result["converged"] is True
+    assert result["version_skew"] == 0
+    assert set(result["weights"].values()) == {2}
+    assert result["convergence_s"] is not None
+    assert result["replica_restarts"] == [0, 0]
+    assert result["post_rollout_request"] == "done"
+    assert result["hotswap"]["rollouts_converged"] >= 1
