@@ -1,0 +1,37 @@
+"""Thread-correctness layer: static rules' runtime counterpart.
+
+``analysis/rules/{thread_shared,lock_discipline,thread_lifecycle}`` lint
+the threaded surface statically; this package instruments it live:
+
+    from pytorch_distributed_training_tpu.analysis import concurrency
+
+    self._lock = concurrency.lock("serve.queue")   # drop-in Lock
+
+Mode rides the same ``PDT_TPU_GUARDS`` env as ``analysis/guards.py``:
+``off`` — plain stdlib locks, zero overhead; ``record`` (default) —
+contention/hold/wait accounting + ``lock_order_violation`` /
+``lock_across_device`` telemetry; ``strict`` — order inversions raise
+``LockOrderViolation`` before the lock is taken. See ``locks.py``.
+"""
+
+from pytorch_distributed_training_tpu.analysis.concurrency.locks import (
+    LockOrderViolation,
+    LockRegistry,
+    TracedLock,
+    get_lock_registry,
+    held_lock_names,
+    lock,
+    rlock,
+    set_lock_registry,
+)
+
+__all__ = [
+    "LockOrderViolation",
+    "LockRegistry",
+    "TracedLock",
+    "get_lock_registry",
+    "held_lock_names",
+    "lock",
+    "rlock",
+    "set_lock_registry",
+]

@@ -41,6 +41,11 @@ from typing import Any, Optional
 
 import jax
 
+from pytorch_distributed_training_tpu.analysis.concurrency.locks import (
+    get_lock_registry,
+    held_lock_names,
+)
+
 _MODES = ("off", "record", "strict")
 
 # ------------------------------------------------------- trace accounting
@@ -111,15 +116,21 @@ def _registry_or_default(registry):
 class GuardedCall:
     """Wrapper installed by ``GuardSet.wrap_jit`` around one jitted entry
     point. Transparent to the call contract; adds per-call retrace
-    accounting and transfer-guard arming once warm. An AOT ``Compiled``
+    accounting, transfer-guard arming once warm, a lock-across-device
+    check (dispatching compiled work while holding an instrumented lock
+    serializes every thread needing it behind the accelerator), and —
+    with ``audit_donation`` — a one-shot post-first-compile donation
+    audit built from the warm-up call's avals. An AOT ``Compiled``
     (no ``_cache_size`` trace cache) gets NO warm-up allowance — it can
     never legally trace; a jit gets exactly one warm-up call."""
 
-    def __init__(self, name: str, fn, guards: "GuardSet"):
+    def __init__(self, name: str, fn, guards: "GuardSet",
+                 audit_donation: bool = False):
         self.name = name
         self.fn = fn
         self.guards = guards
         self._warm = not hasattr(fn, "_cache_size")
+        self._audit_donation = audit_donation
         self.calls = 0
         self.recompiles = 0
 
@@ -127,10 +138,35 @@ class GuardedCall:
     def warm(self) -> bool:
         return self._warm
 
+    def _donation_audit_from(self, args, kwargs) -> None:
+        """Cheap post-first-compile donation audit: re-lower against the
+        warm-up call's avals (shape/dtype metadata stays readable on
+        donated buffers; no backend compile, no data touched) and parse
+        the aliasing out of the lowering text."""
+        try:
+            specs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (args, dict(kwargs)),
+            )
+            lowered = self.fn.lower(*specs[0], **specs[1])
+        except Exception as e:  # pragma: no cover - lowering quirk
+            self.guards.registry.emit({
+                "record": "donation_audit", "name": self.name,
+                "aliased": None, "ok": None, "error": str(e)[:200],
+            })
+            return
+        donation_audit(
+            self.name, lowered,
+            registry=self.guards.registry, mode=self.guards.mode,
+        )
+
     def __call__(self, *args, **kwargs):
         g = self.guards
         if g.mode == "off":
             return self.fn(*args, **kwargs)
+        held = held_lock_names()
+        if held:
+            g._lock_boundary_violation(self.name, held)
         self.calls += 1
         warm = self._warm
         ctx = g._transfer_context() if warm else contextlib.nullcontext()
@@ -145,6 +181,8 @@ class GuardedCall:
         traced = _trace_count() - traces_before
         if not warm:
             self._warm = True  # the one expected warm-up compile
+            if self._audit_donation:
+                self._donation_audit_from(args, kwargs)
         elif traced:
             self.recompiles += 1
             g._recompile_violation(self, traced)
@@ -176,11 +214,14 @@ class GuardSet:
 
     # ------------------------------------------------------------- wrapping
 
-    def wrap_jit(self, name: str, fn):
-        """Wrap a jitted (or AOT-compiled) callable; idempotent."""
+    def wrap_jit(self, name: str, fn, *, audit_donation: bool = False):
+        """Wrap a jitted (or AOT-compiled) callable; idempotent. With
+        ``audit_donation`` the first (warm-up) call also audits that the
+        donation requested at jit time survived to the executable —
+        the serve programs\' post-first-compile hook."""
         if isinstance(fn, GuardedCall):
             return fn
-        wrapped = GuardedCall(name, fn, self)
+        wrapped = GuardedCall(name, fn, self, audit_donation=audit_donation)
         self.wrapped[name] = wrapped
         return wrapped
 
@@ -191,11 +232,28 @@ class GuardSet:
             return contextlib.nullcontext()
         return jax.transfer_guard("disallow" if self.mode == "strict" else "log")
 
+    def _lock_boundary_violation(self, name: str, held) -> None:
+        """A compiled call/device region entered with instrumented locks
+        held: record it (the lock registry emits ``lock_across_device``);
+        strict mode raises — the accelerator\'s latency just became every
+        waiter\'s latency."""
+        get_lock_registry().check_device_boundary(name)
+        if self.mode == "strict":
+            raise GuardViolation(
+                f"device boundary {name!r} entered while holding "
+                f"instrumented lock(s) {list(held)} — dispatching device "
+                f"work under a lock serializes every thread needing it"
+            )
+
     @contextlib.contextmanager
     def transfer_scope(self, name: str):
         """Arm the implicit-transfer detector around a host code region
         (e.g. one serve tick). Violations emit ``implicit_transfer`` and,
-        in strict mode, re-raise as ``TransferGuardError``."""
+        in strict mode, re-raise as ``TransferGuardError``. Also checks
+        no instrumented lock is held across the scope\'s entry."""
+        held = held_lock_names()
+        if held and self.mode != "off":
+            self._lock_boundary_violation(name, held)
         try:
             with self._transfer_context():
                 yield

@@ -1,17 +1,27 @@
 """Rule registry: every lint rule module, in reporting order.
 
-A rule module exposes ``RULE_ID`` (``host_transfer`` exposes two) and
-``check(ctx: ModuleContext) -> list[Finding]``. Adding a rule = adding a
-module here; the driver (``analysis/lint.py``) and ``scripts/lint.py``
-pick it up automatically.
+A rule module exposes ``RULE_ID`` (or ``RULE_IDS`` when it reports more
+than one — ``host_transfer``'s legacy ``LOOP_RULE_ID`` is still honored)
+and ``check(ctx: ModuleContext) -> list[Finding]``. Adding a rule =
+adding a module here; the driver (``analysis/lint.py``) and
+``scripts/lint.py`` pick it up automatically.
+
+The JAX rules (traced-branch … mutable-default) came with PR 5; the
+concurrency rules (thread_shared, lock_discipline, thread_lifecycle)
+lint the hand-rolled threaded surface — serve loop, router, fleet,
+hotswap watcher, prefetch, telemetry sink — against the race/deadlock/
+shutdown-hang classes documented in each module.
 """
 
 from pytorch_distributed_training_tpu.analysis.rules import (
     donation,
     host_transfer,
     impure_call,
+    lock_discipline,
     mutable_default,
     prng_reuse,
+    thread_lifecycle,
+    thread_shared,
     traced_branch,
 )
 from pytorch_distributed_training_tpu.analysis.rules.common import (
@@ -26,16 +36,21 @@ ALL_RULES = (
     donation,
     prng_reuse,
     mutable_default,
+    thread_shared,
+    lock_discipline,
+    thread_lifecycle,
 )
 
-RULE_IDS = tuple(
-    rid
-    for mod in ALL_RULES
-    for rid in (
-        (mod.RULE_ID, mod.LOOP_RULE_ID)
-        if hasattr(mod, "LOOP_RULE_ID")
-        else (mod.RULE_ID,)
-    )
-)
+
+def _ids(mod) -> tuple:
+    ids = getattr(mod, "RULE_IDS", None)
+    if ids is not None:
+        return tuple(ids)
+    if hasattr(mod, "LOOP_RULE_ID"):
+        return (mod.RULE_ID, mod.LOOP_RULE_ID)
+    return (mod.RULE_ID,)
+
+
+RULE_IDS = tuple(rid for mod in ALL_RULES for rid in _ids(mod))
 
 __all__ = ["ALL_RULES", "RULE_IDS", "Finding", "ModuleContext"]

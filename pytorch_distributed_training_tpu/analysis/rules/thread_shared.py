@@ -1,0 +1,111 @@
+"""Rules ``thread-shared-mutable`` and ``unlocked-rmw``.
+
+The two statically-visible shapes of a data race on ``self`` state:
+
+- **thread-shared-mutable**: an attribute written from one thread root
+  (a ``Thread(target=self._x)`` body and everything it calls) and
+  read/written from a different root (another thread entry, or the
+  "external" root — any method other threads may call) with no common
+  lock across the conflicting accesses. The torn-``JsonlSink`` lines PR 6
+  tripped over were exactly this shape.
+- **unlocked-rmw**: a read-modify-write (``self.n += 1``,
+  ``self.xs.append(...)``, ``self.d[k] = ...``) with no lock held, in an
+  externally-callable method of a class that is visibly concurrent
+  (starts threads or owns locks). Two HTTP handler threads running the
+  same method race each other — the GIL makes each bytecode atomic, not
+  the read-increment-store sequence.
+
+Exemptions (see ``threadmodel``): lock attrs and thread-safe-by-
+construction attrs (Events, Queues, semaphores, deques), accesses in
+``__init__`` (construction happens-before thread start), and methods
+whose every call site provably holds a lock. Single-writer flags a class
+publishes deliberately (``_loop_failed``-style booleans) are the waiver
+file's job — with the reason the pattern is safe.
+"""
+
+from __future__ import annotations
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+)
+from pytorch_distributed_training_tpu.analysis.rules.threadmodel import (
+    EXTERNAL,
+    class_models,
+)
+
+RULE_ID = "thread-shared-mutable"
+RMW_RULE_ID = "unlocked-rmw"
+RULE_IDS = (RULE_ID, RMW_RULE_ID)
+
+
+def check(ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for model in class_models(ctx):
+        if not model.thread_using:
+            continue
+        exempt = model.lock_attrs | model.safe_attrs
+        accs = [a for a in model.accesses() if a.attr not in exempt]
+
+        # ---- thread-shared-mutable: cross-root conflicts ----------------
+        by_attr: dict[str, list] = {}
+        for a in accs:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, attr_accs in sorted(by_attr.items()):
+            writes = [a for a in attr_accs if a.is_write]
+            if not writes:
+                continue
+            roots = frozenset().union(*(a.roots for a in attr_accs))
+            if len(roots) < 2 or roots == {EXTERNAL}:
+                continue    # one root only, or no thread involved
+            # a conflict needs a write in one root and any access in
+            # another — an attr written and read under the same single
+            # root never races
+            write_roots = frozenset().union(*(a.roots for a in writes))
+            conflicting = [
+                a for a in attr_accs if a.roots - write_roots or a.is_write
+            ]
+            if len(
+                frozenset().union(*(a.roots for a in conflicting))
+            ) < 2:
+                continue
+            common = frozenset.intersection(
+                *(a.locks for a in conflicting)
+            )
+            if common:
+                continue
+            first = min(
+                (a for a in conflicting if not a.locks),
+                key=lambda a: (a.node.lineno, a.node.col_offset),
+                default=conflicting[0],
+            )
+            methods = sorted({a.method for a in conflicting})
+            findings.append(Finding(
+                RULE_ID, ctx.path, first.node.lineno,
+                first.node.col_offset,
+                f"{model.ctx.qualnames.get(model.cls, model.cls.name)}"
+                f".{first.method}",
+                f"attribute `{attr}` is written on one thread and "
+                f"accessed on another ({', '.join(methods)}) with no "
+                f"common lock — guard every access with one lock, or "
+                f"waive with the reason the publication is safe",
+            ))
+
+        # ---- unlocked-rmw: racy increments in externally-callable code --
+        seen: set[tuple] = set()
+        for a in accs:
+            if a.kind != "rmw" or a.locks or EXTERNAL not in a.roots:
+                continue
+            key = (a.attr, a.method)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                RMW_RULE_ID, ctx.path, a.node.lineno, a.node.col_offset,
+                f"{model.ctx.qualnames.get(model.cls, model.cls.name)}"
+                f".{a.method}",
+                f"unlocked read-modify-write of `{a.attr}` in a method "
+                f"callable from any thread of a threaded class — "
+                f"concurrent callers lose updates",
+            ))
+    return findings

@@ -183,6 +183,13 @@ def main(argv=None) -> dict:
         stats = fleet.stats()
         if sink is not None:
             sink.emit({"record": "fleet_summary", **stats})
+            # the fleet process' own lock accounting (router/breaker/
+            # watcher locks); replicas emit theirs into their own streams
+            from pytorch_distributed_training_tpu.analysis.concurrency import (
+                get_lock_registry,
+            )
+
+            sink.emit(get_lock_registry().summary_record())
             sink.flush(fsync=True)
     return stats
 

@@ -251,6 +251,13 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         stats = server.stats()
         if sink is not None:
             sink.emit({"record": "serve_summary", **stats})
+            # per-lock contention/hold/wait accounting for the whole run
+            # (analysis/concurrency) — summarize_metrics' "locks" section
+            from pytorch_distributed_training_tpu.analysis.concurrency import (
+                get_lock_registry,
+            )
+
+            sink.emit(get_lock_registry().summary_record())
             sink.flush(fsync=True)
     if preempted["signal"] is not None:
         # graceful preemption drain: exit 75 (EX_TEMPFAIL) so a fleet

@@ -40,6 +40,7 @@ import threading
 from time import perf_counter
 from typing import Iterator
 
+from pytorch_distributed_training_tpu.analysis import concurrency
 from pytorch_distributed_training_tpu.telemetry.registry import get_registry
 
 _ITEM, _DONE, _ERROR = 0, 1, 2
@@ -56,6 +57,10 @@ class PrefetchingIterator:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._finished = False  # consumer saw _DONE/_ERROR
+        # close() races itself: the Trainer's finally and __del__ (GC, any
+        # thread) may both tear down — the lock makes the drain+join run
+        # exactly once (instrumented; analysis/concurrency)
+        self._close_lock = concurrency.lock("data.prefetch.close")
         self._closed = False
         self.last_occupancy = 0
         self.last_wait_s = 0.0
@@ -134,9 +139,10 @@ class PrefetchingIterator:
 
     def close(self) -> None:
         """Stop the worker, drain the queue, join — idempotent."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         # unblock a producer waiting on a full queue
         while True:

@@ -91,16 +91,20 @@ class Watchdog:
     # ------------------------------------------------------------ thresholds
 
     def stall_after_s(self, what: str) -> float:
-        hist = self._history.get(what)
-        if not hist:
-            return self.min_stall_s
-        med = sorted(hist)[len(hist) // 2]
+        # _history is written by guard exits (any thread) and read by the
+        # monitor thread: both sides go through the condition's lock
+        with self._cond:
+            hist = self._history.get(what)
+            if not hist:
+                return self.min_stall_s
+            med = sorted(hist)[len(hist) // 2]
         return max(self.min_stall_s, self.stall_factor * med)
 
     def observe(self, what: str, seconds: float) -> None:
-        self._history.setdefault(what, deque(maxlen=32)).append(
-            float(seconds)
-        )
+        with self._cond:
+            self._history.setdefault(what, deque(maxlen=32)).append(
+                float(seconds)
+            )
 
     # ----------------------------------------------------------------- guard
 

@@ -67,14 +67,40 @@ def mask_scale_jax(rng, shape, rate: float, dtype):
     return jnp.where(bits >= mask_threshold(rate), scale, jnp.zeros((), dtype))
 
 
-def kernel_keep_mask(shape, rate: float):
-    """In-kernel Bernoulli(1-rate) keep mask from the ALREADY-SEEDED
-    per-core TPU PRNG (call ``pltpu.prng_seed`` first). Shared by every
-    Pallas dropout site (flash attention, the LN tails, mask_scale) so the
-    threshold semantics cannot drift."""
+def kernel_prng_seed(*seeds) -> None:
+    """``pltpu.prng_seed``, skipped in off-TPU interpret mode: the Mosaic
+    PRNG primitives have no CPU lowering in this jax, and interpret-mode
+    bits are all-zeros anyway (NOTES.md) — seeding a generator that will
+    not be read would only crash the interpreter. Every kernel seeds
+    through here so the gate can't drift per site."""
+    from pytorch_distributed_training_tpu.ops.dispatch import (
+        interpret_active,
+    )
+
+    if interpret_active():
+        return
     from jax.experimental.pallas import tpu as pltpu
 
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    pltpu.prng_seed(*seeds)
+
+
+def kernel_keep_mask(shape, rate: float):
+    """In-kernel Bernoulli(1-rate) keep mask from the ALREADY-SEEDED
+    per-core TPU PRNG (call ``kernel_prng_seed`` first). Shared by every
+    Pallas dropout site (flash attention, the LN tails, mask_scale) so the
+    threshold semantics cannot drift. Off-TPU interpret mode emulates the
+    documented all-zeros-bits contract (every position drops for rate>0)
+    without touching the unlowerable Mosaic PRNG primitives."""
+    from pytorch_distributed_training_tpu.ops.dispatch import (
+        interpret_active,
+    )
+
+    if interpret_active():
+        bits = jnp.zeros(shape, jnp.uint32)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= mask_threshold(rate)
 
 
@@ -82,7 +108,7 @@ def _mask_scale_kernel(seed_ref, o_ref, *, rate: float):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    kernel_prng_seed(seed_ref[0], pl.program_id(0))
     keep = kernel_keep_mask(o_ref.shape, rate)
     # select in fp32 (same 32-bit tiling as the predicate — a bf16 select
     # here trips a Mosaic i1 relayout), convert once at the store
@@ -99,6 +125,10 @@ def _mask_scale_from_seed(seed, shape, rate: float, dtype,
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from pytorch_distributed_training_tpu.ops.dispatch import (
+        interpret_active,
+    )
 
     n = 1
     for d in shape:
@@ -117,6 +147,7 @@ def _mask_scale_from_seed(seed, shape, rate: float, dtype,
             out_specs=pl.BlockSpec((br, lanes), lambda i, *_: (i, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((rows, lanes), dtype),
+        interpret=interpret_active(),
     )(seed)
     return out.reshape(shape)
 

@@ -70,6 +70,7 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 
 from pytorch_distributed_training_tpu.ops.dropout import (  # noqa: E402
     kernel_keep_mask as _keep_mask,
+    kernel_prng_seed as _prng_seed,
 )
 
 
@@ -140,7 +141,7 @@ def _fwd_kernel(
         l = l * alpha + jnp.sum(p, axis=-1)
 
         if dropout_rate > 0.0:
-            pltpu.prng_seed(
+            _prng_seed(
                 seed_ref[0], _block_seed(bh, qi, kj, num_qb, num_kb)
             )
             keep = _keep_mask((block_q, block_k), dropout_rate)
@@ -228,7 +229,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
-            pltpu.prng_seed(
+            _prng_seed(
                 seed_ref[0], _block_seed(bh, qi, kj, num_qb, num_kb)
             )
             keep = _keep_mask((block_q, block_k), dropout_rate)
@@ -277,7 +278,7 @@ def _kblock_bwd_math(
     p = jnp.exp(s - lse)  # [block_q, block_k] — the one probs recompute
 
     if dropout_rate > 0.0:
-        pltpu.prng_seed(
+        _prng_seed(
             seed_ref[0], _block_seed(bh, qi, kj, num_qb, num_kb)
         )
         keep = _keep_mask((block_q, block_k), dropout_rate)
@@ -491,7 +492,7 @@ def _mh_fwd_kernel(
         if dropout_rate > 0.0:
             # same (batch*heads + h) stream id as the multi-block path's
             # _block_seed(bh, 0, 0, 1, 1) so seed derivation stays uniform
-            pltpu.prng_seed(seed_ref[0], b * heads + h)
+            _prng_seed(seed_ref[0], b * heads + h)
             keep = _keep_mask(probs.shape, dropout_rate)
             probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
         v = v_ref[0, h, :, :]
@@ -536,7 +537,7 @@ def _mh_bwd_kernel(
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
-            pltpu.prng_seed(seed_ref[0], b * heads + h)
+            _prng_seed(seed_ref[0], b * heads + h)
             keep = _keep_mask(p.shape, dropout_rate)
             p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
@@ -610,6 +611,7 @@ def _flash_fwd(q, k, v, bias, seed, dropout_rate, causal, block_q, block_k):
                 (batch, heads, q_len, _LANES), jnp.float32
             ),
         ],
+        interpret=_interpreting(),
     )(seed, q, k, v, bias)
     return o, lse
 
@@ -664,6 +666,7 @@ def _flash_fwd_whole_seq(q, k, v, bias, seed, dropout_rate, causal):
             out_specs=[full],
         ),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        interpret=_interpreting(),
     )(seed, q, k, v, bias)[0]
 
 
@@ -705,6 +708,7 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
+            interpret=_interpreting(),
         )(seed, q, k, v, bias, o, do)
         dbias = jnp.zeros_like(bias)
         dseed = np.zeros(seed.shape, jax.dtypes.float0)
@@ -783,6 +787,7 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
+            interpret=_interpreting(),
         )(seed, q, k, v, bias, do, lse, delta)
         dbias = jnp.zeros_like(bias)
         dseed = np.zeros(seed.shape, jax.dtypes.float0)
@@ -825,6 +830,7 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
             ),
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpreting(),
     )(seed, q, k, v, bias, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -874,6 +880,7 @@ def _vjp_bwd(dropout_rate, causal, block_q, block_k, res, do):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        interpret=_interpreting(),
     )(seed, q, k, v, bias, do, lse, delta)
 
     # bias is a mask (non-differentiable by contract); seed is integer
@@ -910,18 +917,28 @@ def tpu_interpret_mode():
     """Run Pallas TPU kernels in interpret mode off-TPU AND tell the flash
     dispatch guard the kernel path is live.
 
-    This is the framework-owned replacement for probing jax's private
-    interpret-mode config: tests (and any CPU-host user who wants the
-    kernel semantics) enter this context instead of
-    ``pltpu.force_tpu_interpret_mode()`` directly, so the dispatch gate
-    (``ops.dispatch.mode``) needs no ``jax._src`` imports.
+    This is the framework-owned replacement for jax's global force-interpret
+    context (``pltpu.force_tpu_interpret_mode`` — removed in the jax this
+    image ships): every ``pl.pallas_call`` in ops/ passes
+    ``interpret=_interpreting()``, so entering this context before the
+    kernel's first trace routes it through the Pallas interpreter. Tests
+    (and any CPU-host user who wants the kernel semantics) enter this
+    context; the dispatch gate (``ops.dispatch.mode``) reads the same
+    thread-local and needs no ``jax._src`` imports.
     """
-    with pltpu.force_tpu_interpret_mode():
-        _INTERPRET.depth = getattr(_INTERPRET, "depth", 0) + 1
-        try:
-            yield
-        finally:
-            _INTERPRET.depth -= 1
+    _INTERPRET.depth = getattr(_INTERPRET, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _INTERPRET.depth -= 1
+
+
+def _interpreting() -> bool:
+    """Trace-time value of the ``interpret=`` kwarg for every Pallas call
+    in ops/: True inside ``tpu_interpret_mode()`` (the context must wrap
+    the kernel's FIRST trace — jit caches bake the flag in, same scoping
+    contract the removed jax global had)."""
+    return getattr(_INTERPRET, "depth", 0) > 0
 
 
 # ------------------------------------------------------------ registration

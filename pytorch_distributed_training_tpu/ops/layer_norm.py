@@ -41,6 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from pytorch_distributed_training_tpu.ops.dropout import (
     derive_kernel_seed,
+    kernel_prng_seed as _prng_seed,
     kernel_keep_mask as _keep_mask,
     pow2_row_block,
     raw_dropout,
@@ -120,6 +121,7 @@ def _fwd(x2d, scale, bias, *, eps: float, out_dtype, block_r: int):
         ],
         out_specs=pl.BlockSpec((block_r, h), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, h), out_dtype),
+        interpret=interpret_active(),
     )(x2d, scale[None, :], bias[None, :])
 
 
@@ -159,6 +161,7 @@ def _bwd(x2d, dy2d, scale, *, eps: float, block_r: int):
             jax.ShapeDtypeStruct((nblocks, 8, h), jnp.float32),
             jax.ShapeDtypeStruct((nblocks, 8, h), jnp.float32),
         ],
+        interpret=interpret_active(),
     )(x2d, dy2d, scale[None, :])
     return dx, jnp.sum(dscale_p[:, 0], axis=0), jnp.sum(dbias_p[:, 0], axis=0)
 
@@ -189,6 +192,7 @@ _fused_layer_norm.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
 
 
 from pytorch_distributed_training_tpu.ops.dispatch import (
+    interpret_active,
     shard_map as _shard_map,
 )
 
@@ -294,7 +298,7 @@ def _dal_fwd_kernel(seed_ref, h_ref, x_ref, scale_ref, bias_ref,
     i = pl.program_id(0)
     hf = h_ref[...].astype(jnp.float32)
     if rate > 0.0:
-        pltpu.prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
+        _prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
         keep = _keep_mask(hf.shape, rate)
         hf = jnp.where(keep, hf * (1.0 / (1.0 - rate)), 0.0)
     s = x_ref[...].astype(jnp.float32) + hf
@@ -332,6 +336,7 @@ def _dal_fwd(h2d, x2d, scale, bias, seed, *, eps, rate, site, out_dtype,
             out_specs=out_specs,
         ),
         out_shape=out_shape,
+        interpret=interpret_active(),
     )(seed, h2d, x2d, scale[None, :], bias[None, :])
     # pallas_call returns a list matching out_shape; normalize to (y, s)
     return (out[0], out[1]) if save_s else (out[0], None)
@@ -348,7 +353,7 @@ def _dal_bwd_kernel(seed_ref, s_ref, dy_ref, scale_ref,
     ds = _ln_dx(xhat, dy, scale_ref[...].astype(jnp.float32), rstd)
     dx_ref[...] = ds.astype(dx_ref.dtype)
     if rate > 0.0:
-        pltpu.prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
+        _prng_seed(seed_ref[0], site * pl.num_programs(0) + i)
         keep = _keep_mask(ds.shape, rate)
         dh = jnp.where(keep, ds * (1.0 / (1.0 - rate)), 0.0)
     else:
@@ -386,6 +391,7 @@ def _dal_bwd(s2d, dy2d, scale, seed, *, eps, rate, site, h_dtype,
             jax.ShapeDtypeStruct((nblocks, 8, hdim), jnp.float32),
             jax.ShapeDtypeStruct((nblocks, 8, hdim), jnp.float32),
         ],
+        interpret=interpret_active(),
     )(seed, s2d, dy2d, scale[None, :])
     return dh, dx, jnp.sum(dscale_p[:, 0], 0), jnp.sum(dbias_p[:, 0], 0)
 

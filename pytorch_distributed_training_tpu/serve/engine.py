@@ -74,6 +74,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_training_tpu.analysis import concurrency
 from pytorch_distributed_training_tpu.analysis.guards import (
     GuardSet,
     guard_mode_from_env,
@@ -216,7 +217,7 @@ class DecodeEngine:
         self.weights_step = weights_step
         self.swaps = 0              # committed swaps
         self.swap_rollbacks = 0     # trial-tick failures rolled back
-        self._swap_lock = threading.Lock()
+        self._swap_lock = concurrency.lock("serve.engine.swap")
         self._pending_swap = None   # (params, version, SwapTicket)
         self._trial = None          # (prev_params, prev_version, ticket)
         if registry is None:
@@ -307,9 +308,12 @@ class DecodeEngine:
 
         # the resident cache is rewritten every prefill: donate it so XLA
         # updates the slot in place instead of holding a second full
-        # [num_slots, ...] cache alive across the call
+        # [num_slots, ...] cache alive across the call; audit_donation
+        # verifies post-first-compile that XLA actually kept the aliasing
         fn = self._guards.wrap_jit(
-            f"serve_prefill_b{bucket}", jax.jit(prefill, donate_argnums=(1,))
+            f"serve_prefill_b{bucket}",
+            jax.jit(prefill, donate_argnums=(1,)),
+            audit_donation=True,
         )
         self._prefill_fns[bucket] = fn
         return fn
@@ -334,11 +338,13 @@ class DecodeEngine:
 
         # cache donated for the same reason as prefill: the decode tick
         # consumes the whole resident cache and returns its replacement
+        # (audited post-first-compile, like prefill)
         self._decode_fn = self._guards.wrap_jit(
             "serve_decode",
             jax.jit(
                 jax.vmap(one, in_axes=(None, 0, 0, 0)), donate_argnums=(1,)
             ),
+            audit_donation=True,
         )
         return self._decode_fn
 

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Optional
 
+from pytorch_distributed_training_tpu.analysis import concurrency
 from pytorch_distributed_training_tpu.faults.inject import (
     _SERVE_KINDS,
 )
@@ -142,6 +143,11 @@ class ReplicaProcess:
         self.graceful_exits = 0
         self.spawns = 0
         self._stopping = threading.Event()
+        # the monitor thread mutates proc/state/counters; sigterm()/stop()/
+        # describe() run on the fleet's control threads — one lock covers
+        # the handoff (linter: thread-shared-mutable on _sigterm_t & co).
+        # Held only for field updates, never across proc.wait()/IO.
+        self._lock = concurrency.lock("serve.fleet.replica")
         self._sigterm_t: Optional[float] = None
         self._thread = threading.Thread(
             target=self._monitor, name=f"fleet-{self.name}", daemon=True
@@ -176,13 +182,14 @@ class ReplicaProcess:
 
     def _spawn_and_wait(self, attempt: int) -> None:
         """One supervised attempt: spawn, record, wait, classify the exit."""
-        self.spawns += 1
         proc = subprocess.Popen(
             self._argv(), env=self._env(),
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
-        self.proc = proc
-        self.state = "up"
+        with self._lock:
+            self.spawns += 1
+            self.proc = proc
+            self.state = "up"
         logger.info(
             "replica %s spawned pid=%d port=%d attempt=%d",
             self.name, proc.pid, self.port, attempt,
@@ -196,12 +203,14 @@ class ReplicaProcess:
         })
         rc = proc.wait()
         graceful = rc == RESUMABLE_EXIT_CODE
+        with self._lock:
+            sigterm_t = self._sigterm_t
+            self._sigterm_t = None
         drain_s = (
-            time.monotonic() - self._sigterm_t
-            if graceful and self._sigterm_t is not None
+            time.monotonic() - sigterm_t
+            if graceful and sigterm_t is not None
             else None
         )
-        self._sigterm_t = None
         self._registry.emit({
             "record": "replica_exit",
             "replica": self.name,
@@ -210,7 +219,8 @@ class ReplicaProcess:
             **({"drain_s": drain_s} if drain_s is not None else {}),
         })
         if graceful:
-            self.graceful_exits += 1
+            with self._lock:
+                self.graceful_exits += 1
             if drain_s is not None:
                 self._registry.emit({
                     "record": "replica_drain",
@@ -241,11 +251,13 @@ class ReplicaProcess:
                     restart_window_s=self._cfg.restart_window_s,
                     max_backoff_s=max(self._cfg.backoff_s * 4, 1.0),
                 )
-                self.state = "stopped"
+                with self._lock:
+                    self.state = "stopped"
                 return
             except Preempted:
                 if self._stopping.is_set():
-                    self.state = "stopped"
+                    with self._lock:
+                        self.state = "stopped"
                     return
                 logger.info(
                     "replica %s drained gracefully; respawning without "
@@ -257,17 +269,20 @@ class ReplicaProcess:
                     "replica %s exhausted its restart budget; pool runs "
                     "degraded", self.name,
                 )
-                self.state = "failed"
+                with self._lock:
+                    self.state = "failed"
+                    restarts_used = self.restarts_used
                 self._registry.emit({
                     "record": "replica_failed",
                     "replica": self.name,
-                    "restarts_used": self.restarts_used,
+                    "restarts_used": restarts_used,
                 })
                 return
 
     def _attempt(self, i: int) -> None:
         if i > 0:
-            self.restarts_used += 1
+            with self._lock:
+                self.restarts_used += 1
         if self._stopping.is_set():
             return
         self._spawn_and_wait(i)
@@ -276,13 +291,16 @@ class ReplicaProcess:
 
     def sigterm(self) -> None:
         """Graceful drain request (the preemption signal)."""
-        proc = self.proc
-        if proc is not None and proc.poll() is None:
+        with self._lock:
+            proc = self.proc
+            if proc is None or proc.poll() is not None:
+                return
             self._sigterm_t = time.monotonic()
-            proc.send_signal(signal.SIGTERM)
+        proc.send_signal(signal.SIGTERM)
 
     def kill(self) -> None:
-        proc = self.proc
+        with self._lock:
+            proc = self.proc
         if proc is not None and proc.poll() is None:
             proc.kill()
 
@@ -298,7 +316,8 @@ class ReplicaProcess:
     def join(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
         self._thread.join(timeout)
-        proc = self.proc
+        with self._lock:
+            proc = self.proc
         if proc is not None and proc.poll() is None:
             try:
                 proc.wait(max(0.1, deadline - time.monotonic()))
@@ -311,16 +330,21 @@ class ReplicaProcess:
                 proc.wait(5.0)
 
     def describe(self) -> dict:
-        proc = self.proc
+        with self._lock:
+            proc = self.proc
+            state = self.state
+            spawns = self.spawns
+            restarts_used = self.restarts_used
+            graceful_exits = self.graceful_exits
         return {
             "replica": self.name,
             "port": self.port,
-            "state": self.state,
+            "state": state,
             "pid": proc.pid if proc is not None else None,
             "alive": proc is not None and proc.poll() is None,
-            "spawns": self.spawns,
-            "restarts_used": self.restarts_used,
-            "graceful_exits": self.graceful_exits,
+            "spawns": spawns,
+            "restarts_used": restarts_used,
+            "graceful_exits": graceful_exits,
         }
 
 

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from pytorch_distributed_training_tpu.analysis import concurrency
 from pytorch_distributed_training_tpu.train.manifest import (
     read_manifest,
     verify_step,
@@ -151,6 +152,11 @@ class CheckpointWatcher:
         self.verify_level = verify_level
         self.name = name
         self._registry = _registry_or_default(registry)
+        # poll state is mutated on the watcher thread but read from others
+        # (poll_once is the synchronous test/CLI entry; the coordinator's
+        # and manager's stats() read current_step/blocklist live) — the
+        # lock covers mutations and snapshots, never the apply_fn call
+        self._lock = concurrency.lock("serve.hotswap.watcher")
         self.current_step: Optional[int] = start_step
         self.blocklist: set[int] = set()
         self._digests: dict[int, str] = {}
@@ -221,7 +227,8 @@ class CheckpointWatcher:
         and blocklist — silently serving either version would make the
         fleet's ``weights_step`` a lie."""
         for step in steps:
-            old = self._digests.get(step)
+            with self._lock:
+                old = self._digests.get(step)
             if old is None:
                 continue
             manifest = read_manifest(self._step_path(step))
@@ -229,20 +236,25 @@ class CheckpointWatcher:
                 continue
             new = manifest_digest(manifest)
             if new != old:
-                self._digests[step] = new  # reject once per re-publish
-                self.blocklist.add(step)
+                with self._lock:
+                    self._digests[step] = new  # reject once per re-publish
+                    self.blocklist.add(step)
                 self._reject(step, "republished with different digests")
 
     def poll_once(self) -> Optional[int]:
         """One poll: returns the step admitted AND applied this round, or
         None (nothing new, nothing eligible, or the apply failed)."""
-        self.polls += 1
+        with self._lock:
+            self.polls += 1
         steps = scan_step_dirs(self.directory)
         self._check_republished(steps)
-        new_steps = [s for s in steps if s not in self._seen]
-        self._seen.update(steps)
-        primed, self._primed = self._primed, True
-        if self.current_step is None:
+        with self._lock:
+            new_steps = [s for s in steps if s not in self._seen]
+            self._seen.update(steps)
+            primed, self._primed = self._primed, True
+            current = self.current_step
+            blocked = set(self.blocklist)
+        if current is None:
             # baseline: the caller is already serving the newest verified
             # step (it booted from it) — record it, don't re-apply it
             base = -1
@@ -253,18 +265,19 @@ class CheckpointWatcher:
                 if ok:
                     base = step
                     break
-            self.current_step = base
+            with self._lock:
+                self.current_step = base
             self._registry.emit({
                 "record": "swap_baseline", "step": base,
             })
             return None
         if primed:
             for step in sorted(new_steps):
-                if step <= self.current_step:
+                if step <= current:
                     self._reject(step, "older than serving step")
         candidates = [
             s for s in sorted(steps, reverse=True)
-            if s > self.current_step and s not in self.blocklist
+            if s > current and s not in blocked
         ]
         for step in candidates:
             path = self._step_path(step)
@@ -279,21 +292,26 @@ class CheckpointWatcher:
                     "%s: step %d not admitted (%s)", self.name, step, reason
                 )
                 continue
-            self._digests[step] = manifest_digest(manifest)
+            with self._lock:
+                self._digests[step] = manifest_digest(manifest)
             self._registry.inc("swap/admitted")
             self._registry.emit({
                 "record": "swap_admitted",
                 "step": step,
-                "from_step": self.current_step,
+                "from_step": current,
             })
-            if self._stop.is_set() and self.admitted:
+            with self._lock:
+                admitted_any = bool(self.admitted)
+            if self._stop.is_set() and admitted_any:
                 # closing: don't start a NEW rollout mid-shutdown
                 return None
             if self.apply_fn(step):
-                self.admitted += 1
-                self.current_step = step
+                with self._lock:
+                    self.admitted += 1
+                    self.current_step = step
                 return step
-            self.blocklist.add(step)
+            with self._lock:
+                self.blocklist.add(step)
             self._registry.inc("swap/blocklisted")
             self._registry.emit({
                 "record": "swap_blocklisted", "step": step,
@@ -378,7 +396,10 @@ class HotSwapManager:
         self.checkpoint_dir = os.path.abspath(checkpoint_dir)
         self.apply_timeout_s = apply_timeout_s
         self._registry = _registry_or_default(registry)
-        self._lock = threading.Lock()
+        # serializes swap_to against the local watcher AND the fleet's
+        # POST /swap (instrumented: a swap holds it for the whole
+        # load+apply window, which the locks telemetry makes visible)
+        self._lock = concurrency.lock("serve.hotswap.manager")
         self.attempts = 0
         self.failures = 0
         # advertised on /healthz while a load+apply is in flight: the

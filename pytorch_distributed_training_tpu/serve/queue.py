@@ -29,6 +29,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from pytorch_distributed_training_tpu.analysis import concurrency
+
 
 class BackpressureError(RuntimeError):
     """The queue is at ``max_depth`` — resubmit later (HTTP front-end: 429)."""
@@ -131,7 +133,9 @@ class RequestQueue:
         self._buckets: dict[int, deque] = {
             b: deque() for b in self.prompt_buckets
         }
-        self._lock = threading.Lock()
+        # instrumented (analysis/concurrency): every front-end thread and
+        # the engine contend here — the locks telemetry section shows it
+        self._lock = concurrency.lock("serve.queue")
         self._work = threading.Condition(self._lock)
         self._closed = False
 

@@ -55,6 +55,7 @@ import time
 import uuid
 from typing import Optional
 
+from pytorch_distributed_training_tpu.analysis import concurrency
 from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -105,12 +106,15 @@ class CircuitBreaker:
         cooldown_s: float = 1.0,
         now_fn=time.monotonic,
         on_transition=None,
+        name: str = "",
     ):
         self.threshold = threshold
         self.cooldown_s = cooldown_s
         self._now = now_fn
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = concurrency.lock(
+            f"serve.router.breaker.{name}" if name else "serve.router.breaker"
+        )
         self.state = self.CLOSED
         self.failures = 0
         self.opened_t: Optional[float] = None
@@ -313,12 +317,18 @@ class Router:
                     threshold=self.config.breaker_threshold,
                     cooldown_s=self.config.breaker_cooldown_s,
                     on_transition=self._breaker_transition_cb(name),
+                    name=name,
                 ),
             )
             for name, host, port in endpoints
         ]
         if not self.replicas:
             raise ValueError("router needs at least one replica endpoint")
+        # request counters and the round-robin cursor are bumped from every
+        # HTTP handler thread at once; the health thread owns the weights
+        # view — one stats lock keeps the increments from losing updates
+        # (linter: unlocked-rmw / thread-shared-mutable)
+        self._lock = concurrency.lock("serve.router.stats")
         self._rr = 0
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
@@ -415,8 +425,11 @@ class Router:
         shifts — the rollout window IS the span where skew > 0, which the
         summarize_metrics swap section folds into a duration."""
         ws = replica.weights_step
-        if self._last_weights.get(replica.name, _UNSET) != ws:
-            self._last_weights[replica.name] = ws
+        with self._lock:
+            changed = self._last_weights.get(replica.name, _UNSET) != ws
+            if changed:
+                self._last_weights[replica.name] = ws
+        if changed:
             self._registry.emit({
                 "record": "router_weights",
                 "replica": replica.name,
@@ -428,8 +441,11 @@ class Router:
                 if r.weights_step is not None
             )
         )
-        if sig != self._last_skew_sig:
-            self._last_skew_sig = sig
+        with self._lock:
+            skew_changed = sig != self._last_skew_sig
+            if skew_changed:
+                self._last_skew_sig = sig
+        if skew_changed:
             skew = self.version_skew()
             self._registry.gauge("router/version_skew", skew)
             self._registry.emit({
@@ -461,8 +477,9 @@ class Router:
             return None
         best = min(r.load() for r in candidates)
         tied = [r for r in candidates if r.load() <= best]
-        self._rr += 1
-        return tied[self._rr % len(tied)]
+        with self._lock:
+            self._rr += 1
+            return tied[self._rr % len(tied)]
 
     def retry_after_s(self) -> int:
         """Advice for a rejected client: the earliest moment the pool could
@@ -483,7 +500,8 @@ class Router:
         retryable error event (headers are long gone by then).
         """
         t0 = time.monotonic()
-        self.routed += 1
+        with self._lock:
+            self.routed += 1
         attempts = 0
         hedged = False
         streamed = False
@@ -494,7 +512,8 @@ class Router:
         while True:
             replica = self.pick(exclude=frozenset(tried))
             if replica is None or attempts > self.config.max_retries:
-                self.rejected += 1
+                with self._lock:
+                    self.rejected += 1
                 self._registry.inc("router/rejected")
                 outcome = {
                     "status": "rejected",
@@ -505,9 +524,11 @@ class Router:
                 break
             attempts += 1
             tried.add(replica.name)
-            replica.requests += 1
+            with self._lock:
+                replica.requests += 1
             if attempts > 1:
-                self.failovers += 1
+                with self._lock:
+                    self.failovers += 1
                 self._registry.inc("router/failovers")
                 self._registry.emit({
                     "record": "router_failover",
@@ -530,7 +551,8 @@ class Router:
                     outcome["replica"] = result["hedge_replica"]
                 hedged = hedged or result.get("hedged", False)
                 break
-            replica.errors += 1
+            with self._lock:
+                replica.errors += 1
             hedged = hedged or result.get("hedged", False)
             if result.get("streamed"):
                 # bytes already reached the client: NOT idempotent anymore.
@@ -596,7 +618,8 @@ class Router:
                 hedge_replica = self.pick(exclude=frozenset({replica.name}))
                 if hedge_replica is not None:
                     hedged = True
-                    self.hedges += 1
+                    with self._lock:
+                        self.hedges += 1
                     self._registry.inc("router/hedges")
                     self._registry.emit({
                         "record": "router_hedge",
@@ -604,7 +627,8 @@ class Router:
                         "primary": replica.name,
                         "hedge": hedge_replica.name,
                     })
-                    hedge_replica.requests += 1
+                    with self._lock:
+                        hedge_replica.requests += 1
                     hedge = _Attempt(hedge_replica, body, rid, cfg)
                     attempt, first = self._race(
                         primary, hedge, cfg.ttfb_timeout_s

@@ -90,9 +90,13 @@ class InferenceServer:
         self._ids = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._draining = False
-        self._drain_requested = False
-        self._loop_failed = False
+        # cross-thread flags are Events, not bools: the serve loop reads
+        # _drain_mode while close() sets it, and health() (HTTP threads)
+        # reads _loop_failed while the loop sets it — an Event is the
+        # lock-free publication the linter's thread-shared rule accepts
+        self._drain_mode = threading.Event()
+        self._drain_requested = threading.Event()
+        self._loop_failed = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -109,7 +113,9 @@ class InferenceServer:
         try:
             while True:
                 if self._stop.is_set():
-                    if not (self._draining and self.engine.has_work()):
+                    if not (
+                        self._drain_mode.is_set() and self.engine.has_work()
+                    ):
                         return
                 worked = self.engine.tick()
                 if not worked and not self._stop.is_set():
@@ -122,7 +128,7 @@ class InferenceServer:
             logger.exception(
                 "serve loop died; cancelling all in-flight requests"
             )
-            self._loop_failed = True    # /healthz: unhealthy, not draining
+            self._loop_failed.set()     # /healthz: unhealthy, not draining
             self.queue.close()
             try:
                 self.engine.cancel_all()
@@ -138,9 +144,12 @@ class InferenceServer:
         while the drain is still finishing in-flight work, not after."""
         if self.hotswap is not None:
             self.hotswap.close()
-        self._drain_requested = True
+        self._drain_requested.set()
         self.queue.close()
-        self._draining = drain
+        if drain:
+            self._drain_mode.set()
+        else:
+            self._drain_mode.clear()
         self._stop.set()
         thread, self._thread = self._thread, None
         if thread is not None:
@@ -208,7 +217,17 @@ class InferenceServer:
 
     @property
     def draining(self) -> bool:
-        return self._drain_requested or self.queue.closed
+        return self._drain_requested.is_set() or self.queue.closed
+
+    def loop_dead(self) -> bool:
+        """True when the serve loop can no longer finish requests — it
+        failed (cancelling everything) or its thread exited. Bounded
+        waiters re-check this instead of blocking forever on a ``done``
+        event a dead loop will never set."""
+        if self._loop_failed.is_set():
+            return True
+        thread = self._thread
+        return thread is not None and not thread.is_alive()
 
     def health(self) -> dict:
         """Liveness + load for routers and external LBs: ``state`` is
@@ -219,7 +238,7 @@ class InferenceServer:
         miss, because the HTTP threads answering /healthz are NOT the
         thread doing the decoding)."""
         thread = self._thread
-        if self._loop_failed:
+        if self._loop_failed.is_set():
             state = "unhealthy"
         elif self.draining:
             state = "draining"
@@ -305,6 +324,16 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
             ),
         })
 
+    def await_done(req: GenRequest) -> None:
+        # bounded wait + liveness re-check: a dead serve loop must surface
+        # as an error event, not hang this waiter forever (unbounded-wait
+        # rule; the loop's own failure path normally fires done first)
+        while not req.done.wait(1.0):
+            if server.loop_dead() and not req.done.is_set():
+                write({"id": req.id, "event": "error",
+                       "error": "serve loop died with the request in flight"})
+                return
+
     pending: list[GenRequest] = []
     served = 0
     for line in in_stream:
@@ -349,7 +378,7 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
         pending.append(req)
         served += 1
     for req in pending:
-        req.done.wait()
+        await_done(req)
     return served
 
 
@@ -514,7 +543,23 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 while True:
-                    ev = events.get()
+                    # bounded pop + liveness re-check: if the serve loop
+                    # died without finishing this request, close the
+                    # stream with an explicit terminal error instead of
+                    # holding the connection open forever
+                    try:
+                        ev = events.get(timeout=1.0)
+                    except _q.Empty:
+                        if server.loop_dead() and events.empty():
+                            self.wfile.write((json.dumps({
+                                "id": rid,
+                                "event": "error",
+                                "error": "serve loop died mid-stream",
+                                "retryable": True,
+                            }) + "\n").encode())
+                            self.wfile.flush()
+                            break
+                        continue
                     if ev is None:
                         break
                     self.wfile.write((json.dumps(ev) + "\n").encode())

@@ -29,6 +29,8 @@ from typing import Any
 
 import jax
 
+from pytorch_distributed_training_tpu.analysis import concurrency
+
 
 def _jsonable(x: Any):
     """Best-effort coercion for config values (paths, numpy scalars)."""
@@ -62,8 +64,12 @@ class JsonlSink:
         self._file = None
         # serving emits from many threads at once (router request handlers,
         # the health loop, fleet monitors); a lock keeps each JSONL line
-        # atomic — interleaved torn lines would poison the whole stream
-        self._lock = threading.Lock()
+        # atomic — interleaved torn lines would poison the whole stream.
+        # Instrumented: sink contention is the first suspect when every
+        # thread funnels telemetry through one file (per-acquire stats are
+        # in-memory only, so instrumenting the sink's own lock can't
+        # recurse into emit)
+        self._lock = concurrency.lock("telemetry.sink")
         self.path = os.path.join(os.path.abspath(metrics_dir), filename)
         if pidx == 0:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)

@@ -1,9 +1,11 @@
-"""JAX correctness linter CLI (analysis/lint.py driver).
+"""JAX + concurrency linter CLI (analysis/lint.py driver).
 
     python scripts/lint.py                 # report findings (waivers applied)
     python scripts/lint.py --check        # exit 1 unless the tree is clean
     python scripts/lint.py --json         # machine-readable report
     python scripts/lint.py serve/ train/  # lint a subset
+    python scripts/lint.py --changed      # only files differing from HEAD
+    python scripts/lint.py --changed origin/main   # ... or a given ref
 
 Every finding must be fixed or waived: ``analysis/waivers.toml`` holds
 ``[[waiver]]`` entries (rule + file [+ symbol] + mandatory reason). With
@@ -13,6 +15,9 @@ telemetry JSONL stream training/serving write, so lint health shows up in
 
 ``--check`` is part of the standard verify flow (see README "Static
 analysis & guards"): the tree must lint clean, modulo waivers, to merge.
+``--changed`` keeps iteration fast (lint what you touched); the full-repo
+gate stays in tier-1. Unused-waiver warnings are suppressed under
+``--changed`` — a subset run can't see every waiver's file.
 """
 
 from __future__ import annotations
@@ -20,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -37,10 +43,37 @@ from pytorch_distributed_training_tpu.analysis.waivers import (  # noqa: E402
 DEFAULT_PATHS = [os.path.join(REPO_ROOT, "pytorch_distributed_training_tpu")]
 
 
+def changed_files(ref: str = "HEAD", repo_root: str = REPO_ROOT) -> list:
+    """Package .py files differing from ``ref`` (tracked diffs + untracked
+    new files), absolute paths. Raises on a git failure — --changed in a
+    non-repo is an input error, not an empty success."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=repo_root, capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=repo_root, capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    out = []
+    for rel in sorted(set(diff) | set(untracked)):
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(repo_root, rel)
+        if os.path.exists(path):    # deleted files have nothing to lint
+            out.append(path)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to lint (default: the package)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files differing from REF (default HEAD) "
+                        "plus untracked .py files — fast iteration; the "
+                        "full-repo gate stays in tier-1")
     p.add_argument("--check", action="store_true",
                    help="exit 1 when any unwaived finding (or parse error) "
                         "remains")
@@ -57,7 +90,19 @@ def main(argv=None) -> int:
     waivers = []
     if not args.no_waivers and os.path.exists(args.waivers):
         waivers = load_waivers(args.waivers)
-    report = lint_paths(args.paths or DEFAULT_PATHS, waivers)
+    if args.changed is not None:
+        if args.paths:
+            p.error("--changed and explicit paths are mutually exclusive")
+        paths = changed_files(args.changed)
+        if not paths:
+            print(f"0 files changed vs {args.changed}: nothing to lint")
+            return 0
+    else:
+        paths = args.paths or DEFAULT_PATHS
+    report = lint_paths(paths, waivers)
+    if args.changed is not None:
+        # a subset run can't see every waiver's file — unused here != dead
+        report.unused_waivers = []
     summary = summary_record(report)
 
     if args.metrics_dir:

@@ -116,6 +116,7 @@ def summarize(records: list[dict]) -> dict:
         "fleet": summarize_fleet(records),
         "swap": summarize_swap(records),
         "guards": guards,
+        "locks": summarize_locks(records),
     }
 
 
@@ -151,6 +152,62 @@ def summarize_guards(records: list[dict]) -> dict | None:
             "clean": last.get("clean"),
         }
     return out
+
+
+def summarize_locks(records: list[dict]) -> dict | None:
+    """Fold the runtime lock registry's telemetry
+    (``analysis/concurrency``) into the contention view: per-lock
+    acquires/contention/hold stats aggregated across processes (each
+    ``lock_summary`` is cumulative per pid — last record per pid wins,
+    then pids sum), plus every ``lock_order_violation`` /
+    ``lock_across_device`` event. None when the stream holds no lock
+    records."""
+    summaries = [r for r in records if r.get("record") == "lock_summary"]
+    violations = [
+        r for r in records if r.get("record") == "lock_order_violation"
+    ]
+    device_holds = [
+        r for r in records if r.get("record") == "lock_across_device"
+    ]
+    if not (summaries or violations or device_holds):
+        return None
+
+    by_pid: dict = {}
+    for r in summaries:     # cumulative per process: keep the newest
+        by_pid[r.get("pid", 0)] = r
+    locks: dict[str, dict] = {}
+    for rec in by_pid.values():
+        for name, s in (rec.get("locks") or {}).items():
+            row = locks.setdefault(name, {
+                "acquires": 0, "contentions": 0,
+                "wait_total_s": 0.0, "wait_max_s": 0.0, "wait_p99_s": None,
+                "hold_total_s": 0.0, "hold_max_s": 0.0, "hold_p99_s": None,
+            })
+            row["acquires"] += s.get("acquires", 0)
+            row["contentions"] += s.get("contentions", 0)
+            row["wait_total_s"] += s.get("wait_total_s", 0.0)
+            row["wait_max_s"] = max(
+                row["wait_max_s"], s.get("wait_max_s", 0.0)
+            )
+            row["hold_total_s"] += s.get("hold_total_s", 0.0)
+            row["hold_max_s"] = max(
+                row["hold_max_s"], s.get("hold_max_s", 0.0)
+            )
+            for key in ("wait_p99_s", "hold_p99_s"):
+                v = s.get(key)
+                if v is not None:
+                    row[key] = max(row[key] or 0.0, v)
+    return {
+        "processes": len(by_pid),
+        "locks": locks,
+        "order_violations": len(violations),
+        "order_violation_detail": [
+            {"acquiring": r.get("acquiring"), "holding": r.get("holding"),
+             "inverts": r.get("inverts")}
+            for r in violations
+        ],
+        "device_boundary_holds": len(device_holds),
+    }
 
 
 def _pcts(values: list) -> dict | None:
@@ -400,6 +457,55 @@ def render_fleet_table(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def render_locks_table(locks: dict, top_n: int = 8) -> str:
+    """Top-N locks by contention then hold p99, plus any violations."""
+    rows_src = sorted(
+        locks["locks"].items(),
+        key=lambda kv: (
+            -(kv[1]["contentions"]), -(kv[1]["hold_p99_s"] or 0.0),
+            kv[0],
+        ),
+    )[:top_n]
+    cols = ["lock", "acquires", "contended", "wait max ms", "wait p99 ms",
+            "hold max ms", "hold p99 ms"]
+
+    def ms(v):
+        return v * 1e3 if v is not None else None
+
+    rows = [[
+        name, _fmt(s["acquires"]), _fmt(s["contentions"]),
+        _fmt(ms(s["wait_max_s"])), _fmt(ms(s["wait_p99_s"])),
+        _fmt(ms(s["hold_max_s"])), _fmt(ms(s["hold_p99_s"])),
+    ] for name, s in rows_src]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "locks:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    dropped = len(locks["locks"]) - len(rows)
+    foot = (
+        f"processes={locks['processes']} "
+        f"order-violations={locks['order_violations']} "
+        f"device-boundary-holds={locks['device_boundary_holds']}"
+        + (f" (+{dropped} quieter lock(s) not shown)" if dropped > 0 else "")
+        + (" [VIOLATIONS]"
+           if locks["order_violations"] or locks["device_boundary_holds"]
+           else " [clean]")
+    )
+    lines.append(foot)
+    for v in locks["order_violation_detail"]:
+        lines.append(
+            f"  INVERSION: acquiring {v['acquiring']} while holding "
+            f"{v['holding']} (inverts {v['inverts']})"
+        )
+    return "\n".join(lines)
+
+
 def render_table(summary: dict) -> str:
     cols = [
         ("epoch", "epoch"),
@@ -463,6 +569,9 @@ def render_table(summary: dict) -> str:
             f"(p95 {_fmt(ro.get('p95'))}s) "
             f"skew={_fmt(swap.get('skew_s'))}s"
         )
+    locks = summary.get("locks")
+    if locks:
+        lines.append(render_locks_table(locks))
     guards = summary.get("guards")
     if guards:
         bad = (

@@ -1,0 +1,842 @@
+"""Concurrency analysis tests: static thread-safety rules
+(analysis/rules/thread_shared, lock_discipline, thread_lifecycle), the
+runtime lock registry (analysis/concurrency), the guards-layer
+lock-across-device check, the serve donation-audit hook, lint --changed,
+the summarize_metrics "locks" section — and THE tier-1 chaos drill: a
+2-replica fleet with hotswap polling under PDT_TPU_GUARDS=strict and the
+instrumented lock registry live, asserting zero lock-order violations
+and a rendering locks section. CPU-only."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pytorch_distributed_training_tpu.analysis.concurrency import (
+    LockOrderViolation,
+    LockRegistry,
+    TracedLock,
+    held_lock_names,
+    lock,
+    set_lock_registry,
+)
+from pytorch_distributed_training_tpu.analysis.lint import lint_source
+
+pytestmark = pytest.mark.concurrency
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src, path="<string>"):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path=path)]
+
+
+# =====================================================================
+# static rules: one positive and one negative fixture per rule
+# =====================================================================
+
+
+def test_thread_shared_flags_unlocked_cross_thread_attr():
+    src = """
+    import threading
+
+    class Loop:
+        def __init__(self):
+            self.failed = False
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self.failed = True
+
+        def health(self):
+            return self.failed
+    """
+    assert "thread-shared-mutable" in rules_of(src)
+
+
+def test_thread_shared_negative_common_lock_and_safe_attrs():
+    src = """
+    import threading
+
+    class Loop:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stop = threading.Event()     # thread-safe by construction
+            self.n = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while not self._stop.is_set():
+                with self._lock:
+                    self.n += 1
+
+        def snapshot(self):
+            with self._lock:
+                return self.n
+
+        def close(self):
+            self._stop.set()
+    """
+    assert rules_of(src) == []
+
+
+def test_thread_shared_sees_through_private_locked_callee():
+    """swap_to -> _locked pattern: every call site holds the lock, so the
+    private body is analyzed as locked (no finding)."""
+    src = """
+    import threading
+
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = "idle"
+            self._t = threading.Thread(target=self._poll, daemon=True)
+
+        def _poll(self):
+            with self._lock:
+                self._advance()
+
+        def swap(self):
+            with self._lock:
+                self._advance()
+
+        def _advance(self):
+            self.state = "busy"
+    """
+    assert rules_of(src) == []
+
+
+def test_unlocked_rmw_flags_counter_in_threaded_class():
+    src = """
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.routed = 0
+
+        def route(self):
+            self.routed += 1        # handler threads race each other
+    """
+    assert rules_of(src) == ["unlocked-rmw"]
+
+
+def test_unlocked_rmw_negative_unthreaded_class_and_mutator_exempt():
+    src = """
+    import queue
+
+    class Plain:                     # no locks, no threads: not concurrent
+        def __init__(self):
+            self.n = 0
+            self.q = queue.Queue()   # safe attr even in threaded classes
+
+        def bump(self):
+            self.n += 1
+            self.q.put(1)
+    """
+    assert rules_of(src) == []
+
+
+def test_lock_order_cycle_flags_opposite_nestings():
+    src = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    assert "lock-order-cycle" in rules_of(src)
+
+
+def test_lock_order_negative_consistent_order():
+    src = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """
+    assert rules_of(src) == []
+
+
+def test_blocking_call_in_lock_flags_wait_and_http():
+    src = """
+    import threading
+
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done = threading.Event()
+
+        def bad_wait(self):
+            with self._lock:
+                self._done.wait()           # unbounded, lock held
+
+        def bad_http(self, conn):
+            with self._lock:
+                conn.request("GET", "/x")   # I/O under the lock
+    """
+    found = rules_of(src)
+    assert found.count("blocking-call-in-lock") == 2
+
+
+def test_blocking_call_negative_timeouts_and_condition():
+    src = """
+    import threading
+
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._done = threading.Event()
+
+        def ok_bounded(self):
+            with self._lock:
+                self._done.wait(0.5)        # bounded
+
+        def ok_condition(self):
+            with self._cond:
+                self._cond.wait()           # releases the lock by contract
+    """
+    assert rules_of(src) == []
+
+
+def test_non_daemon_thread_flagged_unless_joined_or_daemon():
+    bad = """
+    import threading
+
+    def go():
+        t = threading.Thread(target=print)
+        t.start()
+    """
+    assert rules_of(bad) == ["non-daemon-thread"]
+    joined = """
+    import threading
+
+    def go():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join(5.0)
+    """
+    assert rules_of(joined) == []
+    daemonized = """
+    import threading
+
+    def go():
+        threading.Thread(target=print, daemon=True).start()
+    """
+    assert rules_of(daemonized) == []
+
+
+def test_unbounded_wait_flagged_only_in_threading_modules():
+    src = """
+    import threading
+
+    def collect(req):
+        req.done.wait()
+    """
+    assert rules_of(src) == ["unbounded-wait"]
+    # same call, no threading import: out of the rule's scope
+    src_unscoped = """
+    def collect(req):
+        req.done.wait()
+    """
+    assert rules_of(src_unscoped) == []
+    # bounded or condition-like receivers pass
+    src_ok = """
+    import threading
+
+    def collect(req, cond):
+        req.done.wait(1.0)
+        with cond:
+            cond.wait()
+    """
+    assert rules_of(src_ok) == []
+
+
+def test_repo_concurrency_rules_clean_with_waivers():
+    """The tier-1 gate (mirrors scripts/lint.py --check for the new
+    rules): the package lints clean, every concurrency waiver used."""
+    from pytorch_distributed_training_tpu.analysis.lint import (
+        DEFAULT_WAIVERS,
+        lint_paths,
+    )
+    from pytorch_distributed_training_tpu.analysis.waivers import (
+        load_waivers,
+    )
+
+    report = lint_paths(
+        [os.path.join(REPO_ROOT, "pytorch_distributed_training_tpu")],
+        load_waivers(DEFAULT_WAIVERS),
+    )
+    concurrency_rules = {
+        "thread-shared-mutable", "unlocked-rmw", "lock-order-cycle",
+        "blocking-call-in-lock", "non-daemon-thread", "unbounded-wait",
+    }
+    active = [f for f in report.findings if f.rule in concurrency_rules]
+    assert active == [], [f.format() for f in active]
+    assert not report.errors
+
+
+# =====================================================================
+# runtime lock registry
+# =====================================================================
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        with self._lock:
+            self.records.append(dict(record))
+
+    def flush(self, **kw):
+        pass
+
+    def of(self, kind):
+        with self._lock:
+            return [r for r in self.records if r.get("record") == kind]
+
+
+def _registry():
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    sink = ListSink()
+    reg.attach_sink(sink)
+    return reg, sink
+
+
+def test_traced_lock_stats_and_contention():
+    telemetry, _sink = _registry()
+    reg = LockRegistry(mode="record", registry=telemetry)
+    l = lock("t.stats", registry=reg)
+    assert isinstance(l, TracedLock)
+    with l:
+        assert held_lock_names() == ("t.stats",)
+    assert held_lock_names() == ()
+
+    # force contention: a holder thread sits on the lock while we acquire
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with l:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert held.wait(5)
+    got = [False]
+
+    def contender():
+        with l:
+            got[0] = True
+
+    t2 = threading.Thread(target=contender, daemon=True)
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    t.join(5)
+    t2.join(5)
+    assert got[0]
+    s = reg.summary_record()["locks"]["t.stats"]
+    assert s["acquires"] == 3
+    assert s["contentions"] >= 1
+    assert s["hold_max_s"] > 0
+    assert s["wait_max_s"] > 0
+
+
+def test_lock_order_inversion_record_and_strict():
+    telemetry, sink = _registry()
+    reg = LockRegistry(mode="record", registry=telemetry)
+    a, b = lock("A", registry=reg), lock("B", registry=reg)
+    with a:
+        with b:
+            pass
+
+    def inverted(result):
+        try:
+            with b:
+                with a:
+                    pass
+            result.append("ok")
+        except LockOrderViolation:
+            result.append("raised")
+
+    res = []
+    t = threading.Thread(target=inverted, args=(res,), daemon=True)
+    t.start()
+    t.join(5)
+    assert res == ["ok"]                        # record mode never raises
+    assert reg.order_violations == 1
+    [violation] = sink.of("lock_order_violation")
+    assert violation["acquiring"] == "A" and violation["holding"] == ["B"]
+
+    strict = LockRegistry(mode="strict", registry=telemetry)
+    a2, b2 = lock("A", registry=strict), lock("B", registry=strict)
+    with a2:
+        with b2:
+            pass
+    res2 = []
+
+    def inverted2():
+        try:
+            with b2:
+                with a2:
+                    pass
+            res2.append("ok")
+        except LockOrderViolation:
+            res2.append("raised")
+
+    t = threading.Thread(target=inverted2, daemon=True)
+    t.start()
+    t.join(5)
+    assert res2 == ["raised"]
+    # the strict raise happened BEFORE acquiring: nothing leaked as held
+    assert held_lock_names() == ()
+
+
+def test_mode_off_returns_plain_lock():
+    reg = LockRegistry(mode="off")
+    l = lock("x", registry=reg)
+    assert not isinstance(l, TracedLock)
+    with l:
+        assert held_lock_names() == ()      # uninstrumented
+
+
+def test_condition_over_traced_lock_keeps_held_stack_honest():
+    telemetry, _sink = _registry()
+    reg = LockRegistry(mode="record", registry=telemetry)
+    l = lock("t.cond", registry=reg)
+    cond = threading.Condition(l)
+    observed = []
+
+    def waiter():
+        with cond:
+            observed.append(("pre-wait", held_lock_names()))
+            cond.wait(timeout=5)
+            observed.append(("post-wait", held_lock_names()))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    # while the waiter sleeps inside cond.wait it must NOT hold the lock
+    acquired = l.acquire(timeout=2)
+    assert acquired
+    l.release()
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert observed == [
+        ("pre-wait", ("t.cond",)), ("post-wait", ("t.cond",)),
+    ]
+
+
+def test_guards_flag_lock_held_across_device_boundary():
+    jax = pytest.importorskip("jax")
+    from pytorch_distributed_training_tpu.analysis.guards import (
+        GuardSet,
+        GuardViolation,
+    )
+
+    telemetry, sink = _registry()
+    lock_reg = LockRegistry(mode="record", registry=telemetry)
+    prev = set_lock_registry(lock_reg)
+    try:
+        guards = GuardSet(mode="record", registry=telemetry)
+        fn = guards.wrap_jit("boundary_fn", jax.jit(lambda x: x + 1))
+        l = lock("t.boundary", registry=lock_reg)
+        with l:
+            fn(1.0)                             # record: flagged, not fatal
+        [rec] = sink.of("lock_across_device")
+        assert rec["boundary"] == "boundary_fn"
+        assert rec["holding"] == ["t.boundary"]
+
+        strict = GuardSet(mode="strict", registry=telemetry)
+        sfn = strict.wrap_jit("boundary_strict", jax.jit(lambda x: x * 2))
+        sfn(1.0)                                # warm it OUTSIDE the lock
+        with pytest.raises(GuardViolation):
+            with l:
+                sfn(2.0)
+        # transfer_scope checks the same invariant
+        with pytest.raises(GuardViolation):
+            with l:
+                with strict.transfer_scope("tick"):
+                    pass
+    finally:
+        set_lock_registry(prev)
+
+
+def test_serve_donation_audit_posts_first_compile_record():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.analysis.guards import GuardSet
+
+    telemetry, sink = _registry()
+    guards = GuardSet(mode="record", registry=telemetry)
+
+    def rewrite(state, delta):
+        return state + delta
+
+    fn = guards.wrap_jit(
+        "donating", jax.jit(rewrite, donate_argnums=(0,)),
+        audit_donation=True,
+    )
+    out = fn(jnp.zeros((256,), jnp.float32), jnp.ones((256,), jnp.float32))
+    assert float(out[0]) == 1.0
+    [audit] = sink.of("donation_audit")
+    assert audit["name"] == "donating"
+    assert audit["ok"] is True and audit["aliased"] >= 1
+    # one-shot: a second (warm) call must not re-audit
+    fn(out, jnp.ones((256,), jnp.float32))
+    assert len(sink.of("donation_audit")) == 1
+
+
+def test_engine_prefill_and_decode_are_donation_audited():
+    """The serve programs' post-first-compile hook end to end: building a
+    tiny engine and serving one request emits a donation_audit for the
+    bucket's prefill and for the decode step, both ok (the resident cache
+    donation survived to the executable)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.serve import (
+        EngineConfig,
+        InferenceServer,
+    )
+    from pytorch_distributed_training_tpu.serve.server import wait_until
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32",
+        attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    telemetry, sink = _registry()
+    server = InferenceServer(
+        model, params,
+        EngineConfig(num_slots=2, prompt_buckets=(8,), max_new_tokens=8),
+        registry=telemetry,
+    ).start()
+    try:
+        req = server.submit(
+            np.arange(1, 6, dtype=np.int32), max_new_tokens=4
+        )
+        assert wait_until(req.done.is_set, timeout=120)
+        assert req.status == "done"
+        audits = {r["name"]: r for r in sink.of("donation_audit")}
+        assert "serve_prefill_b8" in audits and "serve_decode" in audits
+        assert all(a["ok"] for a in audits.values()), audits
+    finally:
+        server.close(drain=False)
+
+
+# =====================================================================
+# lint --changed + summarize locks section
+# =====================================================================
+
+
+def test_lint_changed_mode_runs_and_is_clean():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import lint as lint_cli
+    finally:
+        sys.path.pop(0)
+    files = lint_cli.changed_files("HEAD")
+    assert all(f.endswith(".py") and os.path.isabs(f) for f in files)
+    assert lint_cli.main(["--changed", "HEAD", "--check"]) == 0
+
+
+def test_summarize_locks_section_folds_and_renders(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import summarize_metrics as sm
+    finally:
+        sys.path.pop(0)
+    records = [
+        {"record": "lock_summary", "pid": 1, "mode": "record",
+         "order_violations": 0, "device_boundary_holds": 0,
+         "order_edges": {"a": ["b"]},
+         "locks": {"serve.queue": {
+             "acquires": 100, "contentions": 7, "wait_total_s": 0.1,
+             "wait_max_s": 0.02, "wait_p99_s": 0.015,
+             "hold_total_s": 0.5, "hold_max_s": 0.01, "hold_p99_s": 0.008,
+         }}},
+        # same pid again (newer cumulative snapshot wins)
+        {"record": "lock_summary", "pid": 1, "mode": "record",
+         "order_violations": 0, "device_boundary_holds": 0,
+         "order_edges": {},
+         "locks": {"serve.queue": {
+             "acquires": 150, "contentions": 9, "wait_total_s": 0.2,
+             "wait_max_s": 0.05, "wait_p99_s": 0.02,
+             "hold_total_s": 0.7, "hold_max_s": 0.02, "hold_p99_s": 0.01,
+         }}},
+        {"record": "lock_summary", "pid": 2, "mode": "strict",
+         "order_violations": 0, "device_boundary_holds": 0,
+         "order_edges": {},
+         "locks": {"serve.queue": {
+             "acquires": 50, "contentions": 1, "wait_total_s": 0.01,
+             "wait_max_s": 0.005, "wait_p99_s": 0.004,
+             "hold_total_s": 0.2, "hold_max_s": 0.004, "hold_p99_s": 0.003,
+         }}},
+        {"record": "lock_order_violation", "acquiring": "A",
+         "holding": ["B"], "inverts": "A -> B"},
+    ]
+    locks = sm.summarize_locks(records)
+    assert locks["processes"] == 2
+    row = locks["locks"]["serve.queue"]
+    assert row["acquires"] == 200          # pid1 newest (150) + pid2 (50)
+    assert row["contentions"] == 10
+    assert row["wait_max_s"] == 0.05
+    assert locks["order_violations"] == 1
+    table = sm.render_locks_table(locks)
+    assert "serve.queue" in table and "INVERSION" in table
+    # end to end through the CLI
+    stream = tmp_path / "metrics.jsonl"
+    stream.write_text(
+        "".join(json.dumps(r) + "\n" for r in records)
+    )
+    proc = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", str(stream)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "locks:" in proc.stdout and "serve.queue" in proc.stdout
+
+
+# =====================================================================
+# THE chaos drill: 2-replica fleet + hotswap polling, strict guards +
+# instrumented locks — zero lock-order violations, locks section renders
+# =====================================================================
+
+
+def _post_generate(port, prompt, max_new, rid, timeout=120):
+    import http.client
+
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=timeout
+        )
+        conn.request(
+            "POST", "/generate",
+            body=json.dumps({"prompt": prompt, "max_new_tokens": max_new}),
+            headers={"X-Request-Id": rid},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            conn.close()
+            return {"outcome": "rejected", "status": resp.status}
+        events = [json.loads(l) for l in resp.read().decode().splitlines()]
+        conn.close()
+        last = events[-1] if events else {}
+        return {
+            "outcome": "done" if last.get("event") == "done" else "bad",
+            "events": events,
+        }
+    except Exception as e:      # pragma: no cover - drill diagnostics
+        return {"outcome": "exception", "error": repr(e)}
+
+
+@pytest.mark.chaos
+@pytest.mark.serve
+def test_fleet_hotswap_under_strict_guards_zero_lock_violations(tmp_path):
+    """Acceptance drill: a 2-replica fleet (strict guards + instrumented
+    locks in every process) serves a closed loop while a checkpoint step
+    publishes and hot-swap-polls across the pool. Zero lock-order
+    violations anywhere (the strict registries would have raised; the
+    merged telemetry must hold no lock_order_violation records), every
+    replica's lock_summary lands in its stream, and the summarize
+    "locks" section renders from the merged telemetry."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.serve import (
+        publish_params_checkpoint,
+    )
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+    from pytorch_distributed_training_tpu.serve.server import wait_until
+
+    # strict lock registry for THIS (fleet/router) process: an inversion
+    # in the router/breaker/watcher locks would raise mid-drill
+    strict_locks = LockRegistry(mode="strict")
+    prev_locks = set_lock_registry(strict_locks)
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(cfg)
+    pA = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    pB = jax.tree.map(lambda x: x * 1.01, pA)
+    ckpt_dir = str(tmp_path / "ckpt")
+    publish_params_checkpoint(ckpt_dir, 1, pA)
+
+    reg, sink = _registry()
+    metrics_root = tmp_path / "metrics"
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "2",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "32",
+                "--queue-depth", "16", "--stall-timeout-s", "10",
+                "--checkpoint-dir", ckpt_dir,
+            ),
+            replica_extra_args={
+                i: ("--metrics-dir", str(metrics_root / f"r{i}"))
+                for i in range(2)
+            },
+            # strict guards AND strict lock registry inside each replica
+            replica_env={"PDT_TPU_GUARDS": "strict"},
+            max_restarts=1,
+            backoff_s=0.2,
+            drain_timeout_s=30.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, health_timeout_s=1.0,
+            retry_backoff_s=0.02, retry_backoff_max_s=0.1,
+            ttfb_timeout_s=60.0,
+        ),
+        registry=reg,
+    ).start()
+    httpd = None
+    try:
+        assert fleet.wait_ready(timeout=120), fleet.stats()
+        fleet.enable_hotswap(ckpt_dir, poll_interval_s=0.1)
+        httpd = make_router_http_server(fleet.router)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        # closed-loop wave while step 2 publishes and rolls out
+        n = 6
+        results = [None] * n
+        threads = []
+        for i in range(n):
+            def run(i=i):
+                results[i] = _post_generate(
+                    port, f"lock drill request {i}", 8, f"lk-{i}"
+                )
+            t = threading.Thread(target=run, daemon=True)
+            threads.append(t)
+            t.start()
+        publish_params_checkpoint(ckpt_dir, 2, pB)
+        for t in threads:
+            t.join(180)
+        assert all(not t.is_alive() for t in threads)
+        assert [r["outcome"] for r in results] == ["done"] * n, results
+
+        # the rollout converged on both replicas, zero version skew
+        assert wait_until(
+            lambda: fleet.router.stats()["weights"] == {"r0": 2, "r1": 2}
+            and fleet.router.stats()["version_skew"] == 0,
+            timeout=120,
+        ), fleet.router.stats()
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        # DRAIN stop: each replica's serve_lm exits through its finally,
+        # emitting serve_summary + lock_summary into its metrics dir
+        fleet.stop(drain=True)
+        set_lock_registry(prev_locks)
+
+    # the fleet process itself observed no inversion (strict would have
+    # raised) and its registry agrees
+    assert strict_locks.order_violations == 0
+
+    # merge the fleet-process stream with both replica streams
+    merged = []
+    merged.extend(sink.records)
+    for i in range(2):
+        stream = metrics_root / f"r{i}" / "metrics.jsonl"
+        assert stream.exists(), f"replica {i} wrote no metrics stream"
+        for line in stream.read_text().splitlines():
+            try:
+                merged.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    merged.append(strict_locks.summary_record())
+
+    summaries = [r for r in merged if r.get("record") == "lock_summary"]
+    assert len({r.get("pid") for r in summaries}) >= 3   # 2 replicas + us
+    assert [
+        r for r in merged if r.get("record") == "lock_order_violation"
+    ] == []
+    # the replicas really ran the instrumented hot locks
+    replica_locks = set()
+    for r in summaries:
+        replica_locks.update((r.get("locks") or {}))
+    assert "serve.queue" in replica_locks
+    assert "serve.engine.swap" in replica_locks
+
+    # the summarize "locks" section renders from the recorded telemetry
+    stream = tmp_path / "merged.jsonl"
+    stream.write_text("".join(json.dumps(r) + "\n" for r in merged))
+    proc = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", str(stream),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout)
+    locks = summary["locks"]
+    assert locks["order_violations"] == 0
+    assert locks["device_boundary_holds"] == 0
+    assert locks["processes"] >= 3
+    assert "serve.queue" in locks["locks"]
+    table = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", str(stream)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert table.returncode == 0
+    assert "locks:" in table.stdout and "[clean]" in table.stdout
