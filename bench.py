@@ -473,6 +473,22 @@ class _ListSink:
         pass
 
 
+_BENCH_WAIT_S = 300.0
+
+
+def _await_done(event, what: str) -> None:
+    # bounded: a dead engine loop must FAIL the bench child, not hang it
+    if not event.wait(_BENCH_WAIT_S):
+        raise RuntimeError(f"bench child timed out waiting for {what}")
+
+
+def _join_clients(threads) -> None:
+    for t in threads:
+        t.join(_BENCH_WAIT_S)
+        if t.is_alive():
+            raise RuntimeError("bench client thread failed to finish")
+
+
 def _serve_child(cfg_json: str) -> None:
     import threading
 
@@ -541,7 +557,8 @@ def _serve_child(cfg_json: str) -> None:
     ).start()
     # warm every prefill bucket + the decode step before timing
     for n in buckets:
-        server.submit(warm[n], max_new_tokens=2).done.wait()
+        _await_done(server.submit(warm[n], max_new_tokens=2).done,
+                    f"warmup bucket {n}")
     sink.records.clear()
 
     work = list(prompts)
@@ -562,7 +579,7 @@ def _serve_child(cfg_json: str) -> None:
                     with lock:
                         rejected[0] += 1
                     time.sleep(0.002)
-            req.done.wait()
+            _await_done(req.done, "request completion")
 
     threads = [
         threading.Thread(target=client, daemon=True)
@@ -571,8 +588,7 @@ def _serve_child(cfg_json: str) -> None:
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    _join_clients(threads)
     eng_wall = time.perf_counter() - t0
     server.close(drain=True)
 
@@ -637,6 +653,225 @@ def run_serve(
             f"{proc.stderr[-2000:]}"
         )
     result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+# --------------------------------------------------------------- paged mode
+# Paged-KV + on-device-sampling A/B on CPU: the same closed-loop load as
+# --serve, run through three engine configurations — dense cache + host
+# sampling (the pre-paged engine), paged cache + device sampling (the new
+# default), both on a UNIFORM prompt-length workload, and paged+device on a
+# MIXED workload (prompt lengths spanning 1x-8x) whose page pool is sized
+# BELOW num_slots x longest-context — a shape the dense layout cannot admit
+# at equal memory, since dense charges every slot the longest context.
+# Writes BENCH_paged.json; driven by the `perf`+`serve`-marked pytest,
+# kept out of tier-1 timing noise.
+
+
+def _paged_child(cfg_json: str) -> None:
+    """One engine configuration over one closed-loop workload."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.serve import (
+        BackpressureError,
+        EngineConfig,
+        InferenceServer,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    cfg = json.loads(cfg_json)
+    mix = cfg["prompt_mix"]
+    max_new = cfg["max_new"]
+    n_requests = cfg["requests"]
+
+    mcfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(mcfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"
+    ]
+    rng = np.random.default_rng(42)
+    prompts = [
+        rng.integers(1, mcfg.vocab_size, mix[i % len(mix)]).astype(np.int32)
+        for i in range(n_requests)
+    ]
+
+    registry = MetricsRegistry()
+    sink = _ListSink()
+    registry.attach_sink(sink)
+    buckets = tuple(sorted({len(p) for p in prompts}))
+    ecfg = EngineConfig(
+        num_slots=cfg["slots"], prompt_buckets=buckets,
+        max_new_tokens=max_new,
+        kv_layout=cfg["kv_layout"], sampling=cfg["sampling"],
+        page_size=cfg["page_size"], num_pages=cfg["num_pages"],
+    )
+    server = InferenceServer(
+        model, params, ecfg,
+        queue_depth=cfg["queue_depth"], registry=registry,
+    ).start()
+    # warm every prefill bucket + the decode step before timing (same
+    # sampling params as the load: operands are traced either way, so one
+    # program serves both, but the warm request must not skew percentiles)
+    for n in buckets:
+        _await_done(
+            server.submit(
+                rng.integers(1, mcfg.vocab_size, n).astype(np.int32),
+                max_new_tokens=2, temperature=cfg["temperature"],
+                top_k=cfg["top_k"],
+            ).done,
+            f"warmup bucket {n}",
+        )
+    sink.records.clear()
+
+    work = list(enumerate(prompts))
+    lock = threading.Lock()
+    rejected = [0]
+
+    def client():
+        while True:
+            with lock:
+                if not work:
+                    return
+                i, p = work.pop()
+            while True:
+                try:
+                    req = server.submit(
+                        p, max_new_tokens=max_new,
+                        temperature=cfg["temperature"], top_k=cfg["top_k"],
+                        seed=i,
+                    )
+                    break
+                except BackpressureError:
+                    with lock:
+                        rejected[0] += 1
+                    time.sleep(0.002)
+            _await_done(req.done, "request completion")
+
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(cfg["concurrency"])
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    _join_clients(threads)
+    wall = time.perf_counter() - t0
+    server.close(drain=True)
+
+    serve_summary = _serve_stats_mod().summarize_serve(sink.records)
+    stats = server.stats()
+    result = {
+        "kv_layout": cfg["kv_layout"],
+        "sampling": cfg["sampling"],
+        "prompt_mix": mix,
+        "tokens_per_s": round(serve_summary["tokens"] / wall, 2),
+        "wall_s": round(wall, 3),
+        "tokens": serve_summary["tokens"],
+        "requests": serve_summary["done"],
+        "rejected_submits": rejected[0],
+        "ttft_s": serve_summary["ttft_s"],
+        "tpot_s": serve_summary["tpot_s"],
+        "kv_pages_total": stats.get("kv_pages_total"),
+        "kv_pages_peak": stats.get("kv_pages_peak"),
+        "page_exhausted": stats.get("page_exhausted"),
+    }
+    print(json.dumps(result))
+
+
+def run_paged(
+    requests: int = 16,
+    concurrency: int = 6,
+    slots: int = 4,
+    max_new: int = 16,
+    page_size: int = 8,
+    queue_depth: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+
+    def one(name: str, **over) -> dict:
+        base = dict(
+            requests=requests, concurrency=concurrency, slots=slots,
+            max_new=max_new, queue_depth=queue_depth, page_size=page_size,
+            num_pages=0, temperature=0.8, top_k=20,
+        )
+        base.update(over)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--paged-child", json.dumps(base)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"paged bench variant {name!r} failed "
+                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # uniform A/B: one prompt length, so the only difference between the
+    # variants is cache layout + where sampling runs
+    uniform_mix = [10]
+    dense = one("dense_host", prompt_mix=uniform_mix,
+                kv_layout="dense", sampling="host")
+    paged = one("paged_device", prompt_mix=uniform_mix,
+                kv_layout="paged", sampling="device")
+
+    # mixed workload: prompt lengths spanning 1x-8x, with the page pool
+    # sized BELOW num_slots x longest-context — dense at equal memory
+    # cannot even configure this engine (it charges every slot the
+    # longest context); paged admits the whole mix and backpressures on
+    # pages when the mix momentarily doesn't fit
+    mixed_mix = [6, 12, 24, 48]
+    longest = max(mixed_mix) + max_new
+    pages_per_slot = -(-longest // page_size)
+    dense_equiv_pages = slots * pages_per_slot        # what dense would need
+    mixed_pages = max(pages_per_slot + 1, (3 * dense_equiv_pages) // 4 + 1)
+    mixed = one("paged_mixed", prompt_mix=mixed_mix,
+                kv_layout="paged", sampling="device",
+                num_pages=mixed_pages)
+
+    result = {
+        "metric": (
+            f"paged-KV + device-sampling quick bench (tiny LM, CPU, "
+            f"{requests} requests x {max_new} new tokens, {slots} slots, "
+            f"page {page_size} tok)"
+        ),
+        "uniform": {
+            "prompt_mix": uniform_mix,
+            "dense_host": dense,
+            "paged_device": paged,
+            "speedup": round(
+                paged["tokens_per_s"] / dense["tokens_per_s"], 3
+            ),
+        },
+        "mixed": {
+            "prompt_mix": mixed_mix,
+            "pages_total": mixed["kv_pages_total"],
+            "dense_equivalent_pages": dense_equiv_pages,
+            "pool_below_dense_equiv": (
+                mixed["kv_pages_total"] < dense_equiv_pages
+            ),
+            "paged_device": mixed,
+        },
+    }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1)
@@ -1333,6 +1568,25 @@ def main(argv=None):
     p.add_argument("--serve-out", default="BENCH_serve.json",
                    help="where --serve writes its JSON")
     p.add_argument("--serve-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--paged", action="store_true",
+                   help="paged-KV + device-sampling A/B on CPU: dense+host "
+                        "vs paged+device on a uniform workload, plus "
+                        "paged+device on a mixed 1x-8x prompt-length "
+                        "workload whose page pool is smaller than the "
+                        "dense layout could even configure; writes "
+                        "BENCH_paged.json (no TPU, no probe)")
+    p.add_argument("--paged-requests", type=int, default=16)
+    p.add_argument("--paged-concurrency", type=int, default=6,
+                   help="closed-loop client threads")
+    p.add_argument("--paged-slots", type=int, default=4,
+                   help="engine decode slots")
+    p.add_argument("--paged-max-new", type=int, default=16)
+    p.add_argument("--paged-page-size", type=int, default=8,
+                   help="tokens per KV page")
+    p.add_argument("--paged-queue-depth", type=int, default=4)
+    p.add_argument("--paged-out", default="BENCH_paged.json",
+                   help="where --paged writes its JSON")
+    p.add_argument("--paged-child", default=None, help=argparse.SUPPRESS)
     p.add_argument("--fleet", action="store_true",
                    help="fleet resilience bench on CPU: 2 supervised "
                         "replicas behind the router, one SIGKILLed "
@@ -1374,6 +1628,21 @@ def main(argv=None):
     if args.serve_child:
         _serve_child(args.serve_child)
         return {"serve_child": True}
+    if args.paged_child:
+        _paged_child(args.paged_child)
+        return {"paged_child": True}
+    if args.paged:
+        result = run_paged(
+            requests=args.paged_requests,
+            concurrency=args.paged_concurrency,
+            slots=args.paged_slots,
+            max_new=args.paged_max_new,
+            page_size=args.paged_page_size,
+            queue_depth=args.paged_queue_depth,
+            out_path=args.paged_out,
+        )
+        print(json.dumps(result))
+        return result
     if args.fleet_child:
         _fleet_child(args.fleet_child)
         return {"fleet_child": True}

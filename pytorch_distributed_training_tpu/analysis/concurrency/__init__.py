@@ -23,6 +23,7 @@ from pytorch_distributed_training_tpu.analysis.concurrency.locks import (
     lock,
     rlock,
     set_lock_registry,
+    start_periodic_summary,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "lock",
     "rlock",
     "set_lock_registry",
+    "start_periodic_summary",
 ]

@@ -248,6 +248,66 @@ class LockRegistry:
         return rec
 
 
+class PeriodicSummary:
+    """Background ``lock_summary`` emission on a fixed cadence.
+
+    Shutdown-only summaries have a blind spot: a wedged process never
+    reaches shutdown, so the run that most needs its lock stats reports
+    none. This thread emits the same ``lock_summary`` record every
+    ``interval_s`` seconds while the process lives (daemon — it must never
+    block exit; the final shutdown emission still happens on the main
+    thread). Created via ``start_periodic_summary``; ``stop()`` is
+    idempotent and bounded."""
+
+    def __init__(self, lock_registry: "LockRegistry", interval_s: float,
+                 registry=None):
+        if interval_s <= 0:
+            raise ValueError(
+                f"interval_s must be > 0, got {interval_s} (use no periodic "
+                "summary at all instead of a zero cadence)"
+            )
+        self._lock_registry = lock_registry
+        self._interval_s = float(interval_s)
+        self._registry = registry
+        self._stop = threading.Event()
+        self.emitted = 0
+        self._thread = threading.Thread(
+            target=self._run, name="lock-summary", daemon=True
+        )
+
+    def start(self) -> "PeriodicSummary":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        # bounded wait per cycle; stop() wakes it immediately
+        while not self._stop.wait(self._interval_s):
+            try:
+                self._lock_registry.emit_summary(self._registry)
+                self.emitted += 1
+            except Exception:  # pragma: no cover - sink failure
+                # periodic observability must never kill the process it
+                # observes; the shutdown-path summary still gets a chance
+                pass
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+
+def start_periodic_summary(interval_s: float, *, registry=None,
+                           lock_registry: Optional["LockRegistry"] = None
+                           ) -> PeriodicSummary:
+    """Start in-run ``lock_summary`` emission every ``interval_s`` seconds
+    (the ``--lock-summary-s`` cadence in serve_lm/fleet_lm). Returns the
+    running ``PeriodicSummary``; call ``.stop()`` at shutdown."""
+    return PeriodicSummary(
+        lock_registry if lock_registry is not None else get_lock_registry(),
+        interval_s, registry,
+    ).start()
+
+
 class TracedLock:
     """Instrumented wrapper over one ``threading.Lock``/``RLock``.
 

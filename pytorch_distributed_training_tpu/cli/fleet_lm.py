@@ -52,6 +52,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens-cap", type=int, default=64)
     p.add_argument("--queue-depth", type=int, default=16)
     p.add_argument("--deadline-s", type=float, default=0.0)
+    p.add_argument("--kv-layout", default="paged", choices=("paged", "dense"),
+                   help="replica KV cache layout (see serve_lm)")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="tokens per KV page when --kv-layout=paged")
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="KV page pool size per replica (0 = auto-size)")
+    p.add_argument("--sampling", default="device",
+                   choices=("device", "host"),
+                   help="replica sampling mode (see serve_lm)")
+    p.add_argument("--lock-summary-s", type=float, default=0.0,
+                   help="emit an in-run lock_summary record every this many "
+                        "seconds from the coordinator AND every replica "
+                        "(0 = final summary only)")
     p.add_argument("--max-restarts", type=int, default=2,
                    help="per-replica crash-restart budget (exit 75 drains "
                         "never burn one)")
@@ -121,7 +134,13 @@ def main(argv=None) -> dict:
         "--max-new-tokens-cap", str(args.max_new_tokens_cap),
         "--queue-depth", str(args.queue_depth),
         "--deadline-s", str(args.deadline_s),
+        "--kv-layout", args.kv_layout,
+        "--page-size", str(args.page_size),
+        "--num-pages", str(args.num_pages),
+        "--sampling", args.sampling,
     ]
+    if args.lock_summary_s > 0:
+        replica_args += ["--lock-summary-s", str(args.lock_summary_s)]
     for flag in ("checkpoint_dir", "hf_checkpoint", "vocab", "merges"):
         value = getattr(args, flag)
         if value:
@@ -166,6 +185,18 @@ def main(argv=None) -> dict:
         f"{[r.port for r in fleet.replicas]})"
     )
 
+    lock_summary = None
+    if args.lock_summary_s > 0:
+        # coordinator-side cadence (router/breaker/watcher locks); each
+        # replica runs its own via the forwarded --lock-summary-s flag
+        from pytorch_distributed_training_tpu.analysis.concurrency import (
+            start_periodic_summary,
+        )
+
+        lock_summary = start_periodic_summary(
+            args.lock_summary_s, registry=registry
+        )
+
     stop = threading.Event()
 
     def _on_signal(signum, frame):
@@ -179,6 +210,8 @@ def main(argv=None) -> dict:
         httpd.serve_forever()
     finally:
         log0("draining fleet")
+        if lock_summary is not None:
+            lock_summary.stop()
         fleet.stop(drain=True)
         stats = fleet.stats()
         if sink is not None:

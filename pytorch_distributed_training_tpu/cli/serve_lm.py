@@ -56,6 +56,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-depth", type=int, default=16,
                    help="admission-queue depth; submissions beyond it are "
                         "rejected with a backpressure error")
+    p.add_argument("--kv-layout", default="paged",
+                   choices=("paged", "dense"),
+                   help="KV-cache layout: paged (block-table pages, "
+                        "page-budget admission) or dense (one "
+                        "[slots, cache_len] buffer — the A/B baseline)")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="tokens per KV page (paged layout); 128 matches the "
+                        "TPU lane width for real deployments")
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="total KV pages incl. the reserved null page "
+                        "(0 = auto-size so every slot fits a worst-case "
+                        "request; set lower to trade admission concurrency "
+                        "for KV memory — page exhaustion backpressures)")
+    p.add_argument("--sampling", default="device",
+                   choices=("device", "host"),
+                   help="token selection: device (in-jit sampling, [slots] "
+                        "int32 D2H per tick) or host (fp32 logits D2H + np "
+                        "sampling — the pinned reference path)")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every prefill bucket + the decode step "
+                        "before serving (first request pays no compile; "
+                        "also arms strict tick-wide transfer scoping from "
+                        "the first tick)")
+    p.add_argument("--lock-summary-s", type=float, default=0.0,
+                   help="emit the lock_summary telemetry record every this "
+                        "many seconds DURING the run (0 = shutdown-only; a "
+                        "wedged process never reaches shutdown, so set this "
+                        "on long-lived replicas)")
     p.add_argument("--deadline-s", type=float, default=0.0,
                    help="default per-request deadline (0 = none); queued "
                         "requests past it expire unserved")
@@ -132,6 +160,10 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             "prompt_buckets": args.prompt_buckets,
             "max_new_tokens_cap": args.max_new_tokens_cap,
             "queue_depth": args.queue_depth,
+            "kv_layout": args.kv_layout,
+            "page_size": args.page_size,
+            "num_pages": args.num_pages,
+            "sampling": args.sampling,
         })
 
     config = EngineConfig(
@@ -140,6 +172,11 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             int(b) for b in args.prompt_buckets.split(",") if b.strip()
         ),
         max_new_tokens=args.max_new_tokens_cap,
+        kv_layout=args.kv_layout,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        sampling=args.sampling,
+        warmup=args.warmup,
     )
     from pytorch_distributed_training_tpu.analysis.guards import (
         GuardSet,
@@ -157,6 +194,19 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         stall_timeout_s=args.stall_timeout_s,
         weights_step=boot_step,
     ).start()
+
+    lock_summary = None
+    if args.lock_summary_s > 0:
+        # in-run lock_summary cadence: a wedged replica still leaves its
+        # contention/hold stats in the metrics stream (shutdown-only
+        # emission below never fires for it)
+        from pytorch_distributed_training_tpu.analysis.concurrency import (
+            start_periodic_summary,
+        )
+
+        lock_summary = start_periodic_summary(
+            args.lock_summary_s, registry=registry
+        )
 
     if args.checkpoint_dir and not args.hf_checkpoint:
         # live reload: a continuously fine-tuning job publishes into the
@@ -247,6 +297,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             )
             log0(f"stdio stream closed after {served} requests")
     finally:
+        if lock_summary is not None:
+            lock_summary.stop()
         server.close(drain=True)
         stats = server.stats()
         if sink is not None:

@@ -39,6 +39,7 @@ from pytorch_distributed_training_tpu.ops.attention import (
     make_attention_bias,
 )
 from pytorch_distributed_training_tpu.ops.dropout import Dropout
+from pytorch_distributed_training_tpu.ops.paged_attention import paged_attention
 from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
@@ -137,7 +138,10 @@ class BertSelfAttention(nn.Module):
         k = dense_general(cfg, heads_shape, -1, "key", kw)(x)
         v = dense_general(cfg, heads_shape, -1, "value", kw)(x)
         if cfg.decode:
-            out = self._cached_attend(q, k, v, attention_bias)
+            if cfg.kv_layout == "paged":
+                out = self._paged_attend(q, k, v, attention_bias)
+            else:
+                out = self._cached_attend(q, k, v, attention_bias)
         else:
             dropout_rng = None
             if not deterministic and cfg.attention_dropout > 0.0:
@@ -221,6 +225,104 @@ class BertSelfAttention(nn.Module):
             scores = scores + attention_bias.astype(jnp.float32)
         probs = jax.nn.softmax(scores, axis=-1).astype(cv.value.dtype)
         return jnp.einsum("bnst,btnd->bsnd", probs, cv.value)
+
+    def _paged_attend(self, q, k, v, attention_bias):
+        """Autoregressive attention over PAGED KV (cfg.kv_layout="paged").
+
+        Same flax "cache" collection pattern as ``_cached_attend``, but the
+        K/V buffers are page POOLS shared by every sequence in the batch:
+        ``k_pages``/``v_pages`` [num_pages, page_size, heads, head_dim],
+        addressed through a per-sequence ``block_table`` [batch, W] and
+        ``context_len`` [batch]. The serving engine owns page placement
+        (serve/paged_cache.py) and injects block_table/context_len as traced
+        operands per call; only the pools are engine-resident state.
+
+        Contract with the engine:
+        - prefill (chunk > 1): the sequence is FRESH (context_len == 0) and
+          its block table row covers the chunk; K/V is scattered into its
+          pages and attention is intra-chunk causal — bitwise the dense
+          cache formula at idx == 0.
+        - decode (chunk == 1): one token appended at ``context_len``, then
+          ops/paged_attention gathers the whole context through the block
+          table. Idle batch rows park on the reserved null page 0: their
+          writes land there and their outputs are garbage the host ignores
+          (no lax.select freeze needed — page structure isolates them).
+        """
+        cfg = self.config
+        if not cfg.causal:
+            raise ValueError("decode=True requires a causal model")
+        if cfg.kv_num_pages < 2:
+            raise ValueError(
+                "kv_layout='paged' needs kv_num_pages >= 2 (page 0 is the "
+                f"reserved null page), got {cfg.kv_num_pages}"
+            )
+        batch, chunk, heads, head_dim = q.shape
+        page_size = cfg.kv_page_size
+        is_init = not self.has_variable("cache", "k_pages")
+        kp = self.variable(
+            "cache", "k_pages",
+            lambda: jnp.zeros(
+                (cfg.kv_num_pages, page_size, heads, head_dim), k.dtype
+            ),
+        )
+        vp = self.variable(
+            "cache", "v_pages",
+            lambda: jnp.zeros(
+                (cfg.kv_num_pages, page_size, heads, head_dim), v.dtype
+            ),
+        )
+        # Placeholder shapes only: the engine always supplies real
+        # block_table/context_len values per call (serve/paged_cache.py
+        # with_tables); they are never engine-resident.
+        bt = self.variable(
+            "cache", "block_table",
+            lambda: jnp.zeros((batch, 1), jnp.int32),
+        )
+        cl = self.variable(
+            "cache", "context_len", lambda: jnp.zeros((batch,), jnp.int32)
+        )
+        if is_init:
+            return q
+        idx = cl.value  # [batch]
+        # Scatter this chunk's K/V through the block table: token position
+        # idx+j lives at page bt[b, (idx+j)//P], offset (idx+j)%P.
+        pos = idx[:, None] + jax.lax.broadcasted_iota(
+            jnp.int32, (batch, chunk), 1
+        )
+        page_ids = jnp.take_along_axis(bt.value, pos // page_size, axis=1)
+        offs = pos % page_size
+        kp.value = kp.value.at[page_ids, offs].set(k.astype(kp.value.dtype))
+        vp.value = vp.value.at[page_ids, offs].set(v.astype(vp.value.dtype))
+        cl.value = idx + chunk
+        scale = head_dim ** -0.5
+        if chunk == 1:
+            if attention_bias is not None:
+                raise ValueError(
+                    "paged decode steps take no attention bias (padding is "
+                    "expressed through context_len)"
+                )
+            out = paged_attention(
+                q[:, 0], kp.value, vp.value, bt.value, idx + 1,
+                scale=scale, impl=cfg.paged_attention_impl,
+            )
+            return out[:, None]
+        # Prefill: fresh sequence (idx == 0 by engine contract), so the
+        # visible context IS this chunk — attend intra-chunk with the exact
+        # dense-cache formula (fp32 scores, finfo.min mask, fp32 softmax)
+        # so paged prefill stays bitwise against the dense path.
+        kc = k.astype(kp.value.dtype)
+        vc = v.astype(vp.value.dtype)
+        scores = jnp.einsum(
+            "bsnd,btnd->bnst", q, kc, preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 1)
+        neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where((k_pos <= q_pos)[None, None], scores, neg)
+        if attention_bias is not None:
+            scores = scores + attention_bias.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+        return jnp.einsum("bnst,btnd->bsnd", probs, vc)
 
 
 class BertLayer(nn.Module):

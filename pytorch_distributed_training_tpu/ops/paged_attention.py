@@ -1,0 +1,227 @@
+"""Paged decode attention: single-query attention that gathers K/V through
+a block table over fixed-size pages (vLLM PagedAttention layout).
+
+Shapes
+------
+- ``q``:          [batch, heads, head_dim] — ONE query token per sequence
+                  (this is a decode-step kernel; prefill attends intra-chunk
+                  and never calls it).
+- ``k_pages``/``v_pages``: [num_pages, page_size, heads, head_dim] — the
+                  engine-resident page pools. Page 0 is the reserved null
+                  page (see serve/paged_cache.py); idle sequences park their
+                  block table on it.
+- ``block_table``: [batch, pages_per_seq] int32 — page ids per sequence, in
+                  token order; entries past the live length point at page 0.
+- ``lengths``:    [batch] int32 — valid tokens per sequence INCLUSIVE of the
+                  current query token (the engine writes the new K/V before
+                  attending, so position ``lengths-1`` is the query itself).
+
+Two implementations behind one signature:
+
+- ``impl="reference"``: XLA gather + the exact einsum/softmax formula of the
+  dense flax cache path (models/bert.py ``_cached_attend``). Masked lanes go
+  to ``finfo.min`` so their exp underflows to an exact 0.0 in fp32; paged
+  output is therefore token-identical to the dense cache whatever the pool
+  geometry (same argument that pins slotted serve to one-shot generate).
+- ``impl="pallas"``: an online-softmax page-walk kernel — grid (batch,
+  pages_per_seq), block table scalar-prefetched so each grid step's
+  ``index_map`` streams exactly one page of K/V into VMEM, running
+  max/denominator/accumulator rescaled per page, output written on the last
+  page. ``interpret=`` falls back to the Pallas interpreter off-TPU (same
+  ``tpu_interpret_mode()`` contract as ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_training_tpu.ops.flash_attention import _interpreting
+
+_NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: float,
+    impl: str = "reference",
+) -> jax.Array:
+    """Single-token attention through a page table. Returns [batch, heads,
+    head_dim] in ``v_pages.dtype`` (the dense path's output dtype)."""
+    if q.ndim != 3:
+        raise ValueError(f"q must be [batch, heads, head_dim], got {q.shape}")
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"k_pages/v_pages shapes differ: {k_pages.shape} vs {v_pages.shape}"
+        )
+    if block_table.ndim != 2 or block_table.shape[0] != q.shape[0]:
+        raise ValueError(
+            f"block_table must be [batch, pages_per_seq], got "
+            f"{block_table.shape} for batch {q.shape[0]}"
+        )
+    if lengths.shape != (q.shape[0],):
+        raise ValueError(
+            f"lengths must be [batch], got {lengths.shape} for batch "
+            f"{q.shape[0]}"
+        )
+    if impl == "reference":
+        return _paged_reference(q, k_pages, v_pages, block_table, lengths, scale)
+    if impl == "pallas":
+        return _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale)
+    raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+# ---------------------------------------------------------------- reference
+
+
+def _paged_reference(q, k_pages, v_pages, block_table, lengths, scale):
+    batch, heads, head_dim = q.shape
+    _, page_size, _, _ = k_pages.shape
+    windows = block_table.shape[1]
+
+    # Gather the full (padded) context per sequence: [B, W, P, H, D] →
+    # [B, W*P, H, D]. Token order is page order × in-page offset, which is
+    # exactly how serve/paged_cache.py lays tokens out.
+    k = k_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+    v = v_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+
+    # Same contraction/softmax formula as the dense cache attend (fp32
+    # scores, finfo.min mask, fp32 softmax, probs cast to V dtype) so the
+    # two layouts stay bitwise-comparable on the valid lanes.
+    scores = (
+        jnp.einsum("bnd,btnd->bnt", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    pos = jax.lax.broadcasted_iota(jnp.int32, (batch, windows * page_size), 1)
+    valid = pos < lengths[:, None]
+    scores = jnp.where(valid[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bnt,btnd->bnd", probs, v)
+
+
+# ------------------------------------------------------------------- pallas
+
+
+def _paged_kernel(
+    bt_ref,  # scalar-prefetch: [B, W] int32
+    len_ref,  # scalar-prefetch: [B] int32
+    q_ref,  # [1, H, D]
+    k_ref,  # [1, P, H, D] — the page selected by index_map for this step
+    v_ref,  # [1, P, H, D]
+    o_ref,  # [1, H, D]
+    m_ref,  # VMEM [H, LANES] f32 — running max (broadcast across lanes)
+    l_ref,  # VMEM [H, LANES] f32 — running denominator
+    acc_ref,  # VMEM [H, D] f32 — running numerator
+    *,
+    scale: float,
+    page_size: int,
+    windows: int,
+):
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    length = len_ref[b]
+
+    @pl.when(w == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Pages wholly past the live length carry no valid tokens (their block
+    # table entries are the null page): skip the whole online-softmax step.
+    @pl.when(w * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [H, D]
+        k = k_ref[0].astype(jnp.float32)  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)  # [P, H, D]
+
+        # [H, P]: batch over heads (q dim 0 / k dim 1), contract head_dim.
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (2,)), ((0,), (1,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        pos = w * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_prev = m_ref[...][:, :1]  # [H, 1]
+        l_prev = l_ref[...][:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [H, P]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        # [H, D]: batch over heads (p dim 0 / v dim 1), contract page lanes.
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(w == windows - 1)
+    def _write():
+        # length >= 1 by engine contract, so l > 0; the where only shields
+        # the all-masked degenerate case from producing NaN.
+        l = l_ref[...][:, :1]
+        l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+_LANES = 128
+
+
+def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale):
+    batch, heads, head_dim = q.shape
+    _, page_size, _, _ = k_pages.shape
+    windows = block_table.shape[1]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel,
+            scale=scale,
+            page_size=page_size,
+            windows=windows,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, windows),
+            in_specs=[
+                pl.BlockSpec((1, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0)),
+                # One K/V page per grid step, chosen through the prefetched
+                # block table — this is the whole point of the layout: the
+                # gather happens in the index_map, not in HBM-wasting XLA.
+                pl.BlockSpec(
+                    (1, page_size, heads, head_dim),
+                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, page_size, heads, head_dim),
+                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((heads, _LANES), jnp.float32),
+                pltpu.VMEM((heads, _LANES), jnp.float32),
+                pltpu.VMEM((heads, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, v_pages.dtype),
+        interpret=_interpreting(),
+    )(block_table, lengths, q, k_pages, v_pages)
+    return out

@@ -1,52 +1,55 @@
-"""Continuous-batching decode engine with slotted KV cache.
+"""Continuous-batching decode engine: paged KV cache + on-device sampling.
 
 The one-shot ``models/generate.py`` path compiles a whole
 prefill+scan program per (batch, prompt_len, max_new_tokens) triple and
 holds every request in lockstep — fine for offline batch generation,
 wrong for a server where requests arrive at different times with
 different lengths. This engine is the serving counterpart (continuous
-batching a la Orca; fixed decode slots standing in for vLLM's paged KV
-blocks, which is the shape XLA's static-shape constraint wants):
+batching a la Orca, block-structured KV a la vLLM's PagedAttention):
 
-- The KV cache is ONE resident pytree of ``[num_slots, 1, cache_len,
-  heads, head_dim]`` buffers (plus per-slot ``cache_index``/``pos_index``
-  scalars) — the flax "cache" collection that
-  ``BertSelfAttention._cached_attend`` maintains, with a leading slot
-  axis added by ``jax.vmap``.
+- **KV layout** (``EngineConfig.kv_layout``):
+
+  * ``"paged"`` (default): K/V lives in fixed-size pages —
+    ``[num_pages, page_size, heads, head_dim]`` pools per attention
+    layer — addressed through a per-slot block table that
+    ``serve/paged_cache.py`` allocates on admit and frees on evict
+    (defrag-free; page 0 is the reserved null page idle slots park on).
+    The decode step runs the model at batch ``num_slots`` directly with
+    per-slot ``position_ids``/``context_len`` operands; no vmap, no
+    per-slot freeze select — page structure isolates slots. Admission is
+    a PAGE budget, not a slot-shape budget: one engine serves wildly
+    mixed context lengths, and the pool can be sized well under
+    ``num_slots * cache_len`` tokens (the dense layout's floor) because
+    short requests only hold the pages they need.
+  * ``"dense"``: the PR-4 layout — one resident ``[num_slots, 1,
+    cache_len, ...]`` flax cache, slot-vmapped decode, kept as the A/B
+    baseline (``bench.py --paged``) and fallback.
+
 - **Prefill into a slot**: one jitted program per prompt-length *bucket*
-  (compilation stays bounded by the bucket list, not by observed prompt
-  lengths). The prompt is right-padded to its bucket, run through the
-  decode model batch-1, and the slot's index variables are then patched
-  to the REAL prompt length — so decode continues at the correct
-  position with the correct position embeddings (no right-padding
-  positional gap), and pad K/V entries are overwritten by generated
-  tokens exactly one step before the causal mask would first expose
-  them.
-- **Decode tick**: ONE jitted, slot-vmapped single-token step advances
-  every active slot together; per-slot index scalars (vmap carries them
-  as ``[num_slots]`` vectors) give each slot its own sequence position.
-  Inactive slots compute too (static shapes) but their cache is
-  bit-frozen via ``where(active, new, old)``.
-- Between ticks the engine admits queued requests into free slots and
-  evicts finished ones — a new request's prefill simply overwrites the
-  slot row (stale K/V beyond the patched index is never visible, by the
-  same one-step-ahead argument as padding).
+  (compilation stays bounded by the bucket list). Paged prefill scatters
+  the prompt's K/V straight into the slot's pages and attends
+  intra-chunk (no dense staging buffer); pad positions beyond the real
+  length are overwritten by generated tokens exactly one step before
+  the causal mask would first expose them — same argument as dense.
 
-Sampling runs on the host from fp32 logits: greedy is ``np.argmax``
-(token-identical to ``generate()``'s in-jit argmax — acceptance pins
-this bitwise on ids), temperature>0 draws from a per-request
-``jax.random`` stream folded with the step index. Host-side sampling
-costs one small D2H per tick; on CPU serving (this PR's test target)
-that is noise — a TPU deployment would move sampling on-device, which
-slots in behind the same tick API.
+- **Sampling** (``EngineConfig.sampling``):
+
+  * ``"device"`` (default): temperature/top-k/seed/step ride into the
+    jitted programs as traced per-slot operands and the next token is
+    selected in-trace (``serve/sampling.device_sample``; greedy is a
+    ``jnp.where`` select, per the traced-branch rule). Each tick's D2H
+    is ONE explicit ``jax.device_get`` of ``[slots]`` int32 ids — which
+    is why the whole tick can run under a strict
+    ``GuardSet.transfer_scope`` once every program is warm.
+  * ``"host"``: the PR-4 path — fp32 logits D2H, ``np``/eager sampling
+    on the host. Kept for the A/B and as the reference the device
+    sampler is pinned bit-identical against.
 
 Integration: prefill/decode dispatch+block run under
-``faults.watchdog_guard`` (a wedged device hangs the serve loop exactly
-like a training collective); each tick routes through
-``FaultPlan.slow_host_delay`` so ``PDT_TPU_FAULT=slow_host:<f>x``
-stretches serving time deterministically (deadline/backpressure drills);
-per-request TTFT/TPOT/queue-wait and tick-level queue-depth/slot-
-occupancy go through ``telemetry/`` (JSONL via the process-0-gated sink).
+``faults.watchdog_guard``; each tick routes through
+``FaultPlan.slow_host_delay``; per-request TTFT/TPOT/queue-wait,
+tick-level queue-depth/slot-occupancy and per-tick
+``kv_pages_used``/``kv_pages_free`` go through ``telemetry/``.
 
 Live weight hot-swap (serve/hotswap.py): ``request_swap(params, version)``
 queues a validated replacement params tree from any thread; the serve
@@ -54,13 +57,13 @@ loop applies it at the START of the next tick (``swap_params`` — never
 mid-tick, so a tick is never torn between two weight versions) and the
 OLD params stay alive until the first post-swap tick completes cleanly
 (trial/commit; a trial-tick failure rolls back to them). The resident KV
-cache is untouched by a swap — in-flight slots simply continue decoding
-on the new weights (documented contract; their KV prefix was computed
-under the old version) — and because the replacement tree is validated
-to the same treedef/shapes/dtypes and pre-placed on device, the swap hits
-the existing compiled programs (no retrace, no implicit transfer: clean
-under ``PDT_TPU_GUARDS=strict``). Only the cache is donated, so holding
-the previous params through the trial window is free of copies.
+state (page pools or dense cache) is untouched by a swap — in-flight
+slots simply continue decoding on the new weights — and because the
+replacement tree is validated to the same treedef/shapes/dtypes and
+pre-placed on device, the swap hits the existing compiled programs (no
+retrace, no implicit transfer: clean under ``PDT_TPU_GUARDS=strict``).
+Only the KV state is donated, so holding the previous params through the
+trial window is free of copies.
 """
 
 from __future__ import annotations
@@ -80,11 +83,17 @@ from pytorch_distributed_training_tpu.analysis.guards import (
     guard_mode_from_env,
 )
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
+from pytorch_distributed_training_tpu.serve.paged_cache import (
+    PageAllocator,
+    strip_tables,
+    with_tables,
+)
 from pytorch_distributed_training_tpu.serve.queue import (
     GenRequest,
     RequestQueue,
     emit_expiry,
 )
+from pytorch_distributed_training_tpu.serve.sampling import device_sample
 from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -98,11 +107,32 @@ class EngineConfig:
     request: a request needs ``bucket(prompt) + max_new_tokens <=
     cache_len``, which holds by construction since per-request
     ``max_new_tokens`` is capped at the config value.
+
+    Paged-layout sizing: a request admitted at bucket ``b`` holds
+    ``ceil((b + max_new_tokens) / page_size)`` pages for its whole life
+    (worst case reserved up front, so decode can never starve mid-answer).
+    ``num_pages=0`` auto-sizes the pool so every slot can hold a
+    worst-case request (plus the reserved null page) — functionally
+    equivalent to dense capacity; set it LOWER to trade admission
+    concurrency for KV memory (page-exhaustion backpressure kicks in).
     """
 
     num_slots: int = 4
     prompt_buckets: tuple = (16, 32, 64)
     max_new_tokens: int = 64
+    # KV layout: "paged" (block-table pages, the default) or "dense"
+    # (one [num_slots, cache_len] buffer — the A/B baseline).
+    kv_layout: str = "paged"
+    page_size: int = 16
+    num_pages: int = 0          # total pages incl. null page; 0 = auto
+    # Token selection: "device" (in-jit, [slots] int32 D2H per tick) or
+    # "host" (fp32 logits D2H + np/eager sampling — the pinned reference).
+    sampling: str = "device"
+    paged_attention_impl: str = "reference"
+    # Compile every program (all buckets + decode) at engine build so the
+    # first request never pays compilation and strict tick-wide transfer
+    # scoping arms from the first real tick.
+    warmup: bool = False
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -117,16 +147,48 @@ class EngineConfig:
                 f"prompt_buckets must be positive lengths, got "
                 f"{self.prompt_buckets!r}"
             )
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be dense/paged, got {self.kv_layout!r}"
+            )
+        if self.sampling not in ("host", "device"):
+            raise ValueError(
+                f"sampling must be host/device, got {self.sampling!r}"
+            )
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.kv_layout == "paged" and self.num_pages > 0:
+            if self.num_pages < self.pages_per_slot + 1:
+                raise ValueError(
+                    f"num_pages {self.num_pages} cannot hold even one "
+                    f"worst-case request ({self.pages_per_slot} pages + the "
+                    f"reserved null page) — a lone request would wait on "
+                    f"pages forever"
+                )
 
     @property
     def cache_len(self) -> int:
         return self.prompt_buckets[-1] + self.max_new_tokens
 
+    @property
+    def pages_per_slot(self) -> int:
+        """Block-table row width: pages covering one worst-case request."""
+        return -(-self.cache_len // self.page_size)
+
+    @property
+    def total_pages(self) -> int:
+        """Pool size including the reserved null page 0."""
+        if self.num_pages > 0:
+            return self.num_pages
+        return self.num_slots * self.pages_per_slot + 1
+
 
 def _patch_index_vars(cache, value):
-    """Set every ``cache_index``/``pos_index`` leaf (the flax cache's scalar
-    position state) to ``value`` — the one place the engine steers WHERE the
-    next token lands and WHICH position embedding it gets."""
+    """Set every ``cache_index``/``pos_index`` leaf (the dense flax cache's
+    scalar position state) to ``value`` — the one place the dense engine
+    steers WHERE the next token lands and WHICH position embedding it gets.
+    (The paged layout has no such leaves: positions travel as explicit
+    ``position_ids``/``context_len`` operands.)"""
     def fix(path, leaf):
         key = getattr(path[-1], "key", None)
         if key in ("cache_index", "pos_index"):
@@ -205,7 +267,16 @@ class DecodeEngine:
                 f"{config.max_new_tokens}) exceeds max_position_embeddings "
                 f"{cfg.max_position_embeddings}"
             )
-        self._decode_model = type(model)(dataclasses.replace(cfg, decode=True))
+        paged = config.kv_layout == "paged"
+        dcfg = dataclasses.replace(cfg, decode=True, kv_layout=config.kv_layout)
+        if paged:
+            dcfg = dataclasses.replace(
+                dcfg,
+                kv_page_size=config.page_size,
+                kv_num_pages=config.total_pages,
+                paged_attention_impl=config.paged_attention_impl,
+            )
+        self._decode_model = type(model)(dcfg)
         # explicit placement: restored checkpoints arrive as host arrays,
         # and a host tree reaching the warm compiled calls would be an
         # implicit per-tick H2D (a strict-mode transfer violation)
@@ -230,26 +301,48 @@ class DecodeEngine:
         # Runtime guards (analysis/guards.py): each compiled entry point is
         # wrapped so a retrace after its warm-up compile — one prefill per
         # bucket, one decode step — is a recorded violation, and warm calls
-        # run under the implicit-transfer guard (strict mode: an un-placed
-        # host array reaching a hot call raises instead of silently paying
-        # a per-tick H2D copy).
+        # run under the implicit-transfer guard. In device-sampling mode the
+        # WHOLE tick additionally runs under ``transfer_scope`` once every
+        # program is warm (strict mode: the single token-id device_get is
+        # the only D2H a tick is allowed).
         self._guards = guards or GuardSet(
             mode=guard_mode_from_env(), registry=registry
         )
 
-        # Per-slot cache template comes from a batch-1 abstract init at the
-        # full cache length (no params materialized); the resident cache
-        # stacks it on a leading [num_slots] axis.
-        shapes = jax.eval_shape(
-            lambda: self._decode_model.init(
-                jax.random.key(0),
-                jnp.ones((1, config.cache_len), jnp.int32),
+        if paged:
+            # Page pools are shaped by config, not by the init input; the
+            # abstract init only discovers the cache tree structure. The
+            # block_table/context_len placeholder leaves are per-call
+            # operands, not resident state — strip them.
+            shapes = jax.eval_shape(
+                lambda: self._decode_model.init(
+                    jax.random.key(0),
+                    jnp.ones((1, 1), jnp.int32),
+                    position_ids=jnp.zeros((1, 1), jnp.int32),
+                )
+            )["cache"]
+            self._cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), strip_tables(shapes)
             )
-        )["cache"]
-        self._cache = jax.tree.map(
-            lambda s: jnp.zeros((config.num_slots,) + s.shape, s.dtype),
-            shapes,
-        )
+            self._pages = PageAllocator(
+                config.total_pages, config.page_size,
+                config.pages_per_slot, config.num_slots,
+            )
+        else:
+            # Per-slot cache template comes from a batch-1 abstract init at
+            # the full cache length (no params materialized); the resident
+            # cache stacks it on a leading [num_slots] axis.
+            shapes = jax.eval_shape(
+                lambda: self._decode_model.init(
+                    jax.random.key(0),
+                    jnp.ones((1, config.cache_len), jnp.int32),
+                )
+            )["cache"]
+            self._cache = jax.tree.map(
+                lambda s: jnp.zeros((config.num_slots,) + s.shape, s.dtype),
+                shapes,
+            )
+            self._pages = None
         self._slots: list[Optional[_Slot]] = [None] * config.num_slots
         self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
         self._decode_fn = None
@@ -261,55 +354,107 @@ class DecodeEngine:
         # clock serve-scoped fault injection counts in
         self.admitted = 0
         self.finished = 0
+        self.page_exhausted = 0     # ticks the FIFO head waited on pages
+        self._page_blocked = False  # scratch flag for the admission pass
         # liveness heartbeat: stamped at the end of every tick (including
         # idle ones — the serve loop re-ticks every idle-wait interval), so
         # /healthz can tell "loop wedged mid-tick" from "loop idle"
         self.last_tick_t = time.monotonic()
+        if config.warmup:
+            self._warmup()
 
     # -------------------------------------------------------------- compiled
 
     def _prefill_fn(self, bucket: int):
         """Jitted prefill-into-slot for one prompt bucket. Compiles once per
-        bucket (the queue only produces configured buckets)."""
+        bucket (the queue only produces configured buckets).
+
+        Unified signature across layouts/sampling modes — the sampling
+        operands (seed/temperature/top_k) are traced inputs even in host
+        mode (jit drops unused inputs; keeping ONE signature keeps the
+        call sites and donation audits identical):
+
+        - paged: ``(params, pools, ids, real_len, bt_row, seed, temp, tk)``
+        - dense: ``(params, cache, slot, ids, real_len, seed, temp, tk)``
+
+        Returns ``(token_id | fp32 logits, new KV state)`` — a scalar int32
+        when sampling on device, the last position's ``[vocab]`` logits
+        when sampling on host.
+        """
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
             return fn
+        device = self.config.sampling == "device"
 
-        def prefill(params, cache, slot, ids, real_len):
-            # slot's private cache, position state reset for the new request
-            slot_cache = jax.tree.map(
-                lambda g: jax.lax.dynamic_index_in_dim(
-                    g, slot, 0, keepdims=False
-                ),
-                cache,
-            )
-            slot_cache = _patch_index_vars(slot_cache, 0)
-            # right-padded prompt, no explicit mask: pads sit AFTER the real
-            # tokens, so causal-over-cache masking already hides them from
-            # every real query; pad K/V entries are overwritten by generated
-            # tokens one step before the causal mask would expose them
-            logits, vars_ = self._decode_model.apply(
-                {"params": params, "cache": slot_cache},
-                ids,
-                mutable=["cache"],
-            )
-            new_slot = _patch_index_vars(vars_["cache"], real_len)
-            new_cache = jax.tree.map(
-                lambda g, p: jax.lax.dynamic_update_slice(
-                    g, p[None], (slot,) + (0,) * p.ndim
-                ),
-                cache,
-                new_slot,
-            )
-            last = jnp.take_along_axis(
-                logits, (real_len - 1)[None, None, None], axis=1
-            )[0, 0, :].astype(jnp.float32)
-            return last, new_cache
+        def sample_or_logits(last, seed, temp, top_k):
+            if not device:
+                return last
+            return device_sample(
+                last[None], seed[None], jnp.zeros((1,), jnp.int32),
+                temp[None], top_k[None],
+            )[0]
 
-        # the resident cache is rewritten every prefill: donate it so XLA
-        # updates the slot in place instead of holding a second full
-        # [num_slots, ...] cache alive across the call; audit_donation
-        # verifies post-first-compile that XLA actually kept the aliasing
+        if self._pages is not None:
+
+            def prefill(params, pools, ids, real_len, bt_row, seed, temp,
+                        top_k):
+                # fresh sequence: context_len 0, K/V scattered straight
+                # into the slot's pages through its block-table row
+                cache = with_tables(
+                    pools, bt_row, jnp.zeros((1,), jnp.int32)
+                )
+                logits, vars_ = self._decode_model.apply(
+                    {"params": params, "cache": cache},
+                    ids,
+                    position_ids=jnp.arange(bucket, dtype=jnp.int32)[None],
+                    mutable=["cache"],
+                )
+                new_pools = strip_tables(vars_["cache"])
+                last = jnp.take_along_axis(
+                    logits, (real_len - 1)[None, None, None], axis=1
+                )[0, 0, :].astype(jnp.float32)
+                return sample_or_logits(last, seed, temp, top_k), new_pools
+
+        else:
+
+            def prefill(params, cache, slot, ids, real_len, seed, temp,
+                        top_k):
+                # slot's private cache, position state reset for the new
+                # request
+                slot_cache = jax.tree.map(
+                    lambda g: jax.lax.dynamic_index_in_dim(
+                        g, slot, 0, keepdims=False
+                    ),
+                    cache,
+                )
+                slot_cache = _patch_index_vars(slot_cache, 0)
+                # right-padded prompt, no explicit mask: pads sit AFTER the
+                # real tokens, so causal-over-cache masking already hides
+                # them from every real query; pad K/V entries are
+                # overwritten by generated tokens one step before the
+                # causal mask would expose them
+                logits, vars_ = self._decode_model.apply(
+                    {"params": params, "cache": slot_cache},
+                    ids,
+                    mutable=["cache"],
+                )
+                new_slot = _patch_index_vars(vars_["cache"], real_len)
+                new_cache = jax.tree.map(
+                    lambda g, p: jax.lax.dynamic_update_slice(
+                        g, p[None], (slot,) + (0,) * p.ndim
+                    ),
+                    cache,
+                    new_slot,
+                )
+                last = jnp.take_along_axis(
+                    logits, (real_len - 1)[None, None, None], axis=1
+                )[0, 0, :].astype(jnp.float32)
+                return sample_or_logits(last, seed, temp, top_k), new_cache
+
+        # the resident KV state is rewritten every prefill: donate it so
+        # XLA updates pages/slots in place instead of holding a second full
+        # copy alive across the call; audit_donation verifies
+        # post-first-compile that XLA actually kept the aliasing
         fn = self._guards.wrap_jit(
             f"serve_prefill_b{bucket}",
             jax.jit(prefill, donate_argnums=(1,)),
@@ -319,34 +464,151 @@ class DecodeEngine:
         return fn
 
     def _decode_step_fn(self):
-        """ONE jitted program advancing every slot a single token: vmap over
-        the slot axis gives each slot its own cache_index/pos_index."""
+        """ONE jitted program advancing every slot a single token.
+
+        Unified signature (sampling operands traced in both modes):
+
+        - paged: ``(params, pools, tokens, bt, ctx, seeds, steps, temps,
+          top_ks)`` — batch-``num_slots`` apply with per-slot
+          ``position_ids``/``context_len``; idle slots' block-table rows
+          point at the null page, so their writes land there and their
+          outputs are discarded by the host (no freeze select needed).
+        - dense: ``(params, cache, tokens, active, seeds, steps, temps,
+          top_ks)`` — the slot-vmapped step; inactive slots compute too
+          (static shapes) but their cache is bit-frozen via
+          ``where(active, new, old)``.
+
+        Returns ``([slots] int32 token ids | [slots, vocab] fp32 logits,
+        new KV state)`` by sampling mode.
+        """
         if self._decode_fn is not None:
             return self._decode_fn
+        device = self.config.sampling == "device"
 
-        def one(params, slot_cache, token, active):
-            logits, vars_ = self._decode_model.apply(
-                {"params": params, "cache": slot_cache},
-                jnp.reshape(token, (1, 1)),
-                mutable=["cache"],
-            )
-            new_cache = jax.tree.map(
-                lambda n, o: jnp.where(active, n, o), vars_["cache"],
-                slot_cache,
-            )
-            return logits[0, 0, :].astype(jnp.float32), new_cache
+        if self._pages is not None:
 
-        # cache donated for the same reason as prefill: the decode tick
-        # consumes the whole resident cache and returns its replacement
-        # (audited post-first-compile, like prefill)
+            def decode(params, pools, tokens, bt, ctx, seeds, steps, temps,
+                       top_ks):
+                cache = with_tables(pools, bt, ctx)
+                logits, vars_ = self._decode_model.apply(
+                    {"params": params, "cache": cache},
+                    tokens[:, None],
+                    position_ids=ctx[:, None],
+                    mutable=["cache"],
+                )
+                new_pools = strip_tables(vars_["cache"])
+                last = logits[:, 0, :].astype(jnp.float32)
+                if device:
+                    return (
+                        device_sample(last, seeds, steps, temps, top_ks),
+                        new_pools,
+                    )
+                return last, new_pools
+
+        else:
+
+            def one(params, slot_cache, token, active):
+                logits, vars_ = self._decode_model.apply(
+                    {"params": params, "cache": slot_cache},
+                    jnp.reshape(token, (1, 1)),
+                    mutable=["cache"],
+                )
+                new_cache = jax.tree.map(
+                    lambda n, o: jnp.where(active, n, o), vars_["cache"],
+                    slot_cache,
+                )
+                return logits[0, 0, :].astype(jnp.float32), new_cache
+
+            def decode(params, cache, tokens, active, seeds, steps, temps,
+                       top_ks):
+                logits, new_cache = jax.vmap(
+                    one, in_axes=(None, 0, 0, 0)
+                )(params, cache, tokens, active)
+                if device:
+                    return (
+                        device_sample(logits, seeds, steps, temps, top_ks),
+                        new_cache,
+                    )
+                return logits, new_cache
+
+        # KV state donated for the same reason as prefill: the decode tick
+        # consumes the whole resident cache/pools and returns the
+        # replacement (audited post-first-compile, like prefill)
         self._decode_fn = self._guards.wrap_jit(
             "serve_decode",
-            jax.jit(
-                jax.vmap(one, in_axes=(None, 0, 0, 0)), donate_argnums=(1,)
-            ),
+            jax.jit(decode, donate_argnums=(1,)),
             audit_donation=True,
         )
         return self._decode_fn
+
+    def _warmup(self) -> None:
+        """Compile every serving program (one prefill per bucket + the
+        decode step) with null operands before the engine goes live.
+        Paged warm-up calls run against the reserved null page (all-zero
+        block tables); dense warm-up prefills slot 0 and decodes with
+        every slot inactive — both leave no state a real admit would see.
+        Also the precondition for strict tick-wide transfer scoping: after
+        warm-up, ``_scope_ready()`` holds from the first real tick."""
+        paged = self._pages is not None
+        outs = []
+        for bucket in self.config.prompt_buckets:
+            if paged:
+                ops = jax.device_put((
+                    np.zeros((1, bucket), np.int32),
+                    np.int32(1),
+                    np.zeros((1, self.config.pages_per_slot), np.int32),
+                    np.int32(0), np.float32(0.0), np.int32(0),
+                ))
+            else:
+                ops = jax.device_put((
+                    np.int32(0),
+                    np.zeros((1, bucket), np.int32),
+                    np.int32(1),
+                    np.int32(0), np.float32(0.0), np.int32(0),
+                ))
+            out, self._cache = self._prefill_fn(bucket)(
+                self._params, self._cache, *ops
+            )
+            outs.append(out)
+        S = self.config.num_slots
+        if paged:
+            ops = jax.device_put((
+                np.zeros((S,), np.int32),
+                np.zeros((S, self.config.pages_per_slot), np.int32),
+                np.zeros((S,), np.int32),
+                np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+            ))
+        else:
+            ops = jax.device_put((
+                np.zeros((S,), np.int32),
+                np.zeros((S,), bool),
+                np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+            ))
+        out, self._cache = self._decode_step_fn()(
+            self._params, self._cache, *ops
+        )
+        outs.append(out)
+        # ONE sync for the whole warm-up batch (compiles are synchronous at
+        # dispatch; this only drains the null executions)
+        jax.block_until_ready(outs)
+
+    def _scope_ready(self) -> bool:
+        """True when the whole tick can run under the strict transfer
+        scope: device sampling (host sampling legitimately crosses D2H/H2D
+        in np/eager code) and every program compiled+warm (a cold compile
+        inside the scope would transfer its baked constants — that's what
+        ``warmup=True`` is for)."""
+        if self.config.sampling != "device":
+            return False
+        if self._decode_fn is None or not self._decode_fn.warm:
+            return False
+        for bucket in self.config.prompt_buckets:
+            fn = self._prefill_fns.get(bucket)
+            if fn is None or not fn.warm:
+                return False
+        return True
 
     # ------------------------------------------------------------- hot swap
 
@@ -404,7 +666,7 @@ class DecodeEngine:
         """Atomically install ``params`` as the serving weights. MUST run
         between ticks (the serve loop calls it at tick start via
         ``request_swap``; direct calls are for single-threaded use). The
-        resident KV cache and the compiled programs are untouched — slots
+        resident KV state and the compiled programs are untouched — slots
         in flight continue on the new weights — and the previous params are
         kept alive until ``_commit_swap`` (first clean post-swap tick)."""
         self._validate_swap(params)
@@ -467,9 +729,12 @@ class DecodeEngine:
     # -------------------------------------------------------------- sampling
 
     def _sample(self, req: GenRequest, logits: np.ndarray) -> int:
-        """Next token from fp32 logits. Greedy mirrors generate()'s argmax
-        (token-identical); temperature>0 draws from the request's own
-        deterministic stream (seed folded with the step index)."""
+        """Next token from fp32 logits, on the host (sampling="host").
+        Greedy mirrors generate()'s argmax (token-identical); temperature>0
+        draws from the request's own deterministic stream (seed folded with
+        the step index). ``serve/sampling.device_sample`` is the in-jit
+        mirror of exactly this function — the two are pinned bit-identical
+        by tests/test_paged.py."""
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
         scaled = logits / req.temperature
@@ -563,8 +828,27 @@ class DecodeEngine:
                 return i
         return None
 
+    def _evict(self, slot: int) -> None:
+        """Free ``slot`` for reuse; paged layout also returns its pages."""
+        self._slots[slot] = None
+        if self._pages is not None:
+            self._pages.release(slot)
+
+    def _admission_fits(self, req: GenRequest) -> bool:
+        """Page-budget admission predicate (``RequestQueue.pop_ready``):
+        the whole worst case — bucket + the request's max_new_tokens — must
+        be allocatable up front, so an admitted request can never starve
+        mid-decode. Dense layout admits on slot availability alone."""
+        if self._pages is None:
+            return True
+        need = self._pages.pages_needed(req.bucket + req.max_new_tokens)
+        if self._pages.can_alloc(need):
+            return True
+        self._page_blocked = True
+        return False
+
     def _admit(self, req: GenRequest, slot: int) -> None:
-        """Prefill ``req`` into ``slot`` and sample its first token."""
+        """Prefill ``req`` into ``slot`` and take its first token."""
         req.status = "running"
         req.admit_t = time.monotonic()
         self.admitted += 1
@@ -572,20 +856,52 @@ class DecodeEngine:
         bucket = req.bucket
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : req.prompt_len] = req.prompt_ids
-        with watchdog_guard("serve_prefill"):
-            last, self._cache = self._prefill_fn(bucket)(
-                self._params,
-                self._cache,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(padded),
-                jnp.asarray(req.prompt_len, jnp.int32),
+        paged = self._pages is not None
+        if paged:
+            self._pages.admit(
+                slot, self._pages.pages_needed(bucket + req.max_new_tokens)
             )
-            # explicit d2h (np.asarray would be an implicit transfer — the
-            # exact pattern the transfer guard disallows on real chips)
-            logits = jax.device_get(last)
-        token = self._sample(req, logits)
+        try:
+            # ONE explicit H2D for all host-built operands (np → device);
+            # under the strict tick-wide transfer scope, explicit
+            # device_put/device_get are the only transfers a tick makes
+            sample_ops = (
+                np.int32(req.seed),
+                np.float32(req.temperature),
+                np.int32(min(req.top_k, np.iinfo(np.int32).max)),
+            )
+            if paged:
+                ops = jax.device_put((
+                    padded,
+                    np.int32(req.prompt_len),
+                    self._pages.block_table[slot : slot + 1],
+                ) + sample_ops)
+            else:
+                ops = jax.device_put((
+                    np.int32(slot),
+                    padded,
+                    np.int32(req.prompt_len),
+                ) + sample_ops)
+            with watchdog_guard("serve_prefill"):
+                out, self._cache = self._prefill_fn(bucket)(
+                    self._params, self._cache, *ops
+                )
+                # explicit d2h (np.asarray would be an implicit transfer —
+                # the exact pattern the transfer guard disallows on chips)
+                fetched = jax.device_get(out)
+        except BaseException:
+            # failed admissions must not leak the pages just reserved
+            if paged:
+                self._pages.release(slot)
+            raise
+        if self.config.sampling == "device":
+            token = int(fetched)
+        else:
+            token = self._sample(req, fetched)
         self._emit_token(req, token)
         if self._is_terminal(req, token):
+            if paged:
+                self._pages.release(slot)
             return
         self._slots[slot] = _Slot(request=req, pending_token=token)
 
@@ -614,6 +930,12 @@ class DecodeEngine:
         (previous params released), a failing tick rolls back to the old
         params and the loop keeps serving — a bad swap must degrade the
         weights version, not availability.
+
+        Transfer discipline: once every program is warm and sampling runs
+        on device, the WHOLE tick body executes under
+        ``GuardSet.transfer_scope`` — in strict mode any implicit
+        host<->device copy raises; the tick's only transfers are the
+        explicit operand ``device_put`` and the token-id ``device_get``.
         """
         with self._swap_lock:
             pending, self._pending_swap = self._pending_swap, None
@@ -628,7 +950,11 @@ class DecodeEngine:
                         stage="apply",
                     )
         try:
-            worked = self._tick_body()
+            if self._scope_ready():
+                with self._guards.transfer_scope("serve_tick"):
+                    worked = self._tick_body()
+            else:
+                worked = self._tick_body()
         except Exception as e:
             if self._trial is not None:
                 self._rollback_swap(f"{type(e).__name__}: {e}")
@@ -652,17 +978,21 @@ class DecodeEngine:
         now = time.monotonic()
         for i, s in enumerate(self._slots):
             if s is not None and s.request.overdue(now):
-                self._slots[i] = None
+                self._evict(i)
                 emit_expiry(self._registry, s.request, "running")
                 self._finish(s.request, "expired", "deadline")
                 worked = True
 
-        # admissions: fill free slots in scheduler order
+        # admissions: fill free slots in scheduler order; under the paged
+        # layout the FIFO head must also fit the page budget (a blocked
+        # head blocks the queue — no-bypass backpressure, requests behind
+        # it wait for pages to free rather than starving it)
+        self._page_blocked = False
         while True:
             slot = self._free_slot()
             if slot is None:
                 break
-            req = self._queue.pop_ready()
+            req = self._queue.pop_ready(accept=self._admission_fits)
             if req is None:
                 break
             try:
@@ -676,30 +1006,59 @@ class DecodeEngine:
                 self._finish(req, "error", "admit_failure")
                 raise
             worked = True
+        if self._page_blocked:
+            self.page_exhausted += 1
+            self._registry.inc("serve/page_exhausted")
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if active:
             S = self.config.num_slots
             tokens = np.zeros((S,), np.int32)
             mask = np.zeros((S,), bool)
+            ctx = np.zeros((S,), np.int32)
+            seeds = np.zeros((S,), np.int32)
+            steps = np.zeros((S,), np.int32)
+            temps = np.zeros((S,), np.float32)
+            top_ks = np.zeros((S,), np.int32)
             for i in active:
-                tokens[i] = self._slots[i].pending_token
+                s = self._slots[i]
+                r = s.request
+                tokens[i] = s.pending_token
                 mask[i] = True
-            with watchdog_guard("serve_decode"):
-                logits, self._cache = self._decode_step_fn()(
-                    self._params,
-                    self._cache,
-                    jnp.asarray(tokens),
-                    jnp.asarray(mask),
+                ctx[i] = r.prompt_len + s.steps_done
+                seeds[i] = np.int32(r.seed)
+                steps[i] = s.steps_done + 1   # == len(r.tokens) at sample
+                temps[i] = r.temperature
+                top_ks[i] = min(r.top_k, np.iinfo(np.int32).max)
+            sample_ops = (seeds, steps, temps, top_ks)
+            if self._pages is not None:
+                ops = jax.device_put(
+                    (tokens, self._pages.block_table, ctx) + sample_ops
                 )
-                self._last_logits = jax.device_get(logits)
+            else:
+                ops = jax.device_put((tokens, mask) + sample_ops)
+            with watchdog_guard("serve_decode"):
+                out, self._cache = self._decode_step_fn()(
+                    self._params, self._cache, *ops
+                )
+                # the tick's single D2H: [slots] int32 ids (device
+                # sampling) or [slots, vocab] fp32 logits (host sampling)
+                fetched = jax.device_get(out)
+            if self.config.sampling == "device":
+                sampled = fetched
+            else:
+                self._last_logits = fetched
+                sampled = None
             for i in active:
                 s = self._slots[i]
                 s.steps_done += 1
-                token = self._sample(s.request, self._last_logits[i])
+                if sampled is not None:
+                    token = int(sampled[i])
+                else:
+                    token = self._sample(s.request, self._last_logits[i])
                 self._emit_token(s.request, token)
                 if self._is_terminal(s.request, token):
-                    self._slots[i] = None       # evict: slot free for reuse
+                    self._evict(i)          # slot + pages free for reuse
                 else:
                     s.pending_token = token
             worked = True
@@ -707,6 +1066,9 @@ class DecodeEngine:
         self.ticks += 1
         self._registry.gauge("serve/queue_depth", self._queue.depth())
         self._registry.gauge("serve/slot_occupancy", self.slot_occupancy())
+        if self._pages is not None:
+            self._registry.gauge("serve/kv_pages_used", self._pages.pages_used)
+            self._registry.gauge("serve/kv_pages_free", self._pages.pages_free)
         if worked:
             self.busy_ticks += 1
             self._registry.observe("serve/tick", time.monotonic() - t0)
@@ -736,7 +1098,7 @@ class DecodeEngine:
         partial outputs stay on the request."""
         for i, s in enumerate(self._slots):
             if s is not None:
-                self._slots[i] = None
+                self._evict(i)
                 self._registry.inc("serve/cancelled")
                 self._finish(s.request, "cancelled", "cancelled")
         for req in self._queue.drain_pending():
@@ -744,6 +1106,7 @@ class DecodeEngine:
             self._finish(req, "cancelled", "cancelled")
 
     def stats(self) -> dict:
+        paged = self._pages is not None
         return {
             "ticks": self.ticks,
             "busy_ticks": self.busy_ticks,
@@ -754,6 +1117,14 @@ class DecodeEngine:
             "num_slots": self.config.num_slots,
             "prompt_buckets": list(self.config.prompt_buckets),
             "compiled_prefill_buckets": sorted(self._prefill_fns),
+            "kv_layout": self.config.kv_layout,
+            "sampling": self.config.sampling,
+            "kv_page_size": self.config.page_size if paged else None,
+            "kv_pages_total": self._pages.num_pages - 1 if paged else None,
+            "kv_pages_used": self._pages.pages_used if paged else None,
+            "kv_pages_free": self._pages.pages_free if paged else None,
+            "kv_pages_peak": self._pages.peak_used if paged else None,
+            "page_exhausted": self.page_exhausted,
             "weights_step": self.weights_step,
             "swaps": self.swaps,
             "swap_rollbacks": self.swap_rollbacks,

@@ -1,0 +1,139 @@
+"""Host-side page allocator for the paged KV cache (vLLM block-table style).
+
+The device side (models/bert.py ``_paged_attend`` + ops/paged_attention.py)
+stores K/V in fixed-size pages addressed through a per-slot block table;
+this module owns WHICH pages a slot holds. It is deliberately dumb:
+
+- fixed page size, fixed pool, page ids handed out from a free list;
+- alloc on admit (the whole worst case — prompt + max_new_tokens — up
+  front, so a running request can never starve mid-decode), free on evict;
+- defrag-free: pages are interchangeable, so freeing returns ids to the
+  free list and there is nothing to compact;
+- page 0 is RESERVED as the null page: never allocated, idle slots park
+  their whole block-table row on it, and entries past a live slot's length
+  point at it (reads of those lanes are masked to exact zero by the
+  attention math, writes by idle slots land there harmlessly).
+
+All methods are called with the engine's swap lock held (single-threaded
+tick loop); the allocator itself takes no locks.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+# Cache-collection keys injected/stripped around jitted calls: the engine's
+# resident cache tree holds page POOLS only; block_table/context_len are
+# per-call traced operands.
+_TABLE_KEYS = ("block_table", "context_len")
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` fixed-size KV pages.
+
+    ``block_table`` is the [num_slots, pages_per_slot] int32 array handed to
+    the device verbatim each tick; row ``slot`` lists that slot's pages in
+    token order, null-padded with page 0.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, pages_per_slot: int,
+                 num_slots: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is reserved), got {num_pages}"
+            )
+        if pages_per_slot < 1:
+            raise ValueError(
+                f"pages_per_slot must be >= 1, got {pages_per_slot}"
+            )
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self.num_slots = num_slots
+        # LIFO free list: recently-freed pages are re-handed first, which
+        # keeps the working set of hot pages small.
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._owned: list[list[int]] = [[] for _ in range(num_slots)]
+        self.block_table = np.zeros((num_slots, pages_per_slot), np.int32)
+        self.peak_used = 0
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        # excludes the reserved null page
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        """Pages covering ``total_tokens`` (prompt + worst-case new)."""
+        return -(-max(total_tokens, 1) // self.page_size)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def admit(self, slot: int, n: int) -> None:
+        """Give ``slot`` ``n`` pages and fill its block-table row."""
+        if self._owned[slot]:
+            raise RuntimeError(f"slot {slot} already holds pages")
+        if n > self.pages_per_slot:
+            raise ValueError(
+                f"request needs {n} pages but block-table rows hold "
+                f"{self.pages_per_slot}"
+            )
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, free {len(self._free)} "
+                "(admission must check can_alloc first)"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned[slot] = pages
+        row = self.block_table[slot]
+        row[:] = 0
+        row[: len(pages)] = pages
+        self.peak_used = max(self.peak_used, self.pages_used)
+
+    def release(self, slot: int) -> None:
+        """Return ``slot``'s pages to the free list (no-op when idle)."""
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self.block_table[slot][:] = 0
+
+    def slot_pages(self, slot: int) -> tuple[int, ...]:
+        return tuple(self._owned[slot])
+
+
+def with_tables(pools: Mapping[str, Any], block_table: Any,
+                context_len: Any) -> dict[str, Any]:
+    """Rebuild a full cache tree from engine-resident ``pools`` by injecting
+    ``block_table``/``context_len`` beside every ``k_pages`` leaf (one per
+    attention layer). Used at TRACE level inside the jitted programs."""
+    def walk(node):
+        if isinstance(node, Mapping):
+            out = {k: walk(v) for k, v in node.items()}
+            if "k_pages" in node:
+                out["block_table"] = block_table
+                out["context_len"] = context_len
+            return out
+        return node
+
+    return walk(pools)
+
+
+def strip_tables(cache: Mapping[str, Any]) -> dict[str, Any]:
+    """Inverse of ``with_tables``: drop the per-call table leaves so only
+    the page pools persist between calls (they are what donation recycles)."""
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {
+                k: walk(v) for k, v in node.items() if k not in _TABLE_KEYS
+            }
+        return node
+
+    return walk(cache)

@@ -203,15 +203,25 @@ class RequestQueue:
                 dq.extend(keep)
         return expired
 
-    def pop_ready(self) -> Optional[GenRequest]:
+    def pop_ready(self, accept=None) -> Optional[GenRequest]:
         """FIFO-within-bucket pop: the earliest-submitted request among the
-        bucket heads, or None when idle."""
+        bucket heads, or None when idle.
+
+        ``accept`` (optional) is an admission predicate on the candidate
+        head — the engine's page-budget check. When the scheduler-order
+        head is rejected the pop returns None WITHOUT trying later
+        requests: strict no-bypass FIFO, so a big request blocked on pages
+        is never starved by a stream of small ones slipping past it."""
         with self._lock:
             head = None
             for dq in self._buckets.values():
                 if dq and (head is None or dq[0].submit_t < head[0].submit_t):
                     head = dq
-            return head.popleft() if head is not None else None
+            if head is None:
+                return None
+            if accept is not None and not accept(head[0]):
+                return None
+            return head.popleft()
 
     def wait_for_work(self, timeout: float) -> bool:
         """Engine-side idle wait; returns True when work may be available."""

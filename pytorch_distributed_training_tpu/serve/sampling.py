@@ -1,0 +1,71 @@
+"""On-device batched sampling for the decode engine.
+
+``device_sample`` is the in-jit mirror of ``DecodeEngine._sample`` (the
+host path): greedy argmax at temperature<=0, temperature + top-k
+``jax.random.categorical`` otherwise, with the per-request stream derived
+exactly the same way — ``fold_in(key(seed), step)`` where ``step`` is the
+number of tokens already emitted for the request. Folding sampling into
+the decode program shrinks the per-tick D2H from ``[slots, vocab]`` fp32
+logits to ``[slots]`` int32 token ids, which is the whole point: token
+selection must not cost a host round-trip per token on a real accelerator.
+
+Exactness contract (pinned by tests/test_paged.py): for any
+(seed, step, temperature, top_k) the returned token equals the host
+sampler's bit-for-bit — greedy because both argmax over bitwise-identical
+fp32 logits take the first maximum, sampled because key derivation,
+temperature scaling, the k-th-value tie-keeping top-k mask, and
+``categorical`` are the same operations on the same values.
+
+Everything is traced: temperature/top_k/seed/step arrive as per-slot
+arrays and greedy-vs-sampled is a ``jnp.where`` select, never a Python
+branch (the analysis/ traced-branch rule).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def device_sample(logits, seeds, steps, temps, top_ks):
+    """Sample next tokens for a batch of slots, in-trace.
+
+    Args:
+        logits: [slots, vocab] fp32 — the tick's last-position logits.
+        seeds: [slots] int32 per-request PRNG seed (``GenRequest.seed``;
+            seeds beyond int32 range wrap — the host path's full-width ints
+            and this operand agree on every value int32 can carry).
+        steps: [slots] int32 — tokens already emitted for the request
+            (``len(req.tokens)`` at host sample time: 0 at prefill,
+            ``steps_done + 1`` at decode).
+        temps: [slots] fp32 temperature; <= 0 selects greedy.
+        top_ks: [slots] int32; 0 (or >= vocab) means no truncation.
+
+    Returns:
+        [slots] int32 token ids.
+    """
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Temperature scaling; greedy rows divide by a dummy 1.0 (their sampled
+    # lane is discarded by the final select, but it must not produce inf/nan
+    # that could poison the compiled program's value checks).
+    temps_safe = jnp.where(temps > 0.0, temps, 1.0).astype(logits.dtype)
+    scaled = logits / temps_safe[:, None]
+
+    # top-k mask, host-identical: keep everything >= the k-th largest value
+    # (ties INCLUDED — the host uses np.sort(scaled)[-k] the same way);
+    # k clamped to vocab so an oversized client value means "no truncation".
+    k = jnp.clip(top_ks, 0, vocab)
+    kth_index = jnp.clip(vocab - k, 0, vocab - 1)
+    sorted_scaled = jnp.sort(scaled, axis=-1)
+    kth = jnp.take_along_axis(sorted_scaled, kth_index[:, None], axis=-1)
+    truncate = (k > 0)[:, None] & (scaled < kth)
+    masked = jnp.where(truncate, jnp.finfo(jnp.float32).min, scaled)
+
+    def draw(seed, step, row):
+        key = jax.random.fold_in(jax.random.key(seed), step)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(draw)(seeds, steps, masked).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)

@@ -137,6 +137,25 @@ class ModelConfig:
     # position ids advance from the cached index (models/generate.py). Only
     # meaningful with causal=True; training paths leave this False.
     decode: bool = False
+    # KV-cache layout for decode=True models: "dense" keeps one contiguous
+    # [batch, max_len] buffer per attention layer (the classic flax cache);
+    # "paged" stores K/V in fixed-size pages gathered through a per-sequence
+    # block table (vLLM PagedAttention layout — serve/paged_cache.py owns
+    # the allocator, ops/paged_attention.py the gather kernel). Only read
+    # when decode=True; training paths ignore it.
+    kv_layout: str = "dense"
+    # Tokens per KV page (paged layout only). Real-TPU deployments want the
+    # lane width (128); CPU/tests use small pages to exercise page turnover.
+    kv_page_size: int = 16
+    # Total pages in each layer's pool, INCLUDING the reserved null page 0
+    # (never allocated; idle sequences point at it so their writes are
+    # harmless). Must be set > 0 before building a paged decode model —
+    # the serving engine computes it from its slot/budget config.
+    kv_num_pages: int = 0
+    # Paged decode-attention implementation (ops/paged_attention.py):
+    # "reference" = XLA gather+einsum (bitwise-pinned against the dense
+    # cache path); "pallas" = the online-softmax page-walk kernel.
+    paged_attention_impl: str = "reference"
     # RoBERTa-style embeddings (pad-offset position ids, no token types)
     roberta_style: bool = False
     pad_token_id: int = 0
@@ -202,6 +221,19 @@ class ModelConfig:
                 f"remat_policy={self.remat_policy!r} has no effect without "
                 f"remat=True",
                 stacklevel=2,
+            )
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be dense/paged, got {self.kv_layout!r}"
+            )
+        if self.paged_attention_impl not in ("reference", "pallas"):
+            raise ValueError(
+                f"paged_attention_impl must be reference/pallas, got "
+                f"{self.paged_attention_impl!r}"
+            )
+        if self.kv_layout == "paged" and self.kv_page_size < 1:
+            raise ValueError(
+                f"kv_page_size must be >= 1, got {self.kv_page_size}"
             )
         if self.remat_mlp and self.remat:
             import warnings

@@ -232,6 +232,33 @@ def _pcts(values: list) -> dict | None:
     }
 
 
+def summarize_paged(records: list[dict]) -> dict | None:
+    """Fold the engine's paged-KV accounting (the final ``serve_summary``
+    stats plus the per-tick ``serve/kv_pages_used`` gauge) into the
+    page-pool view: layout/sampling mode, peak page occupancy and
+    page-exhaustion admission rejections. None when the stream predates
+    the paged cache (or the engine ran dense without a summary)."""
+    summaries = [r for r in records if r.get("record") == "serve_summary"]
+    if not summaries:
+        return None
+    last = summaries[-1]
+    if "kv_layout" not in last:
+        return None     # pre-paged stream
+    total = last.get("kv_pages_total")
+    peak = last.get("kv_pages_peak")
+    return {
+        "kv_layout": last.get("kv_layout"),
+        "sampling": last.get("sampling"),
+        "page_size": last.get("kv_page_size"),
+        "pages_total": total,
+        "pages_peak": peak,
+        "peak_occupancy_pct": (
+            100.0 * peak / total if total and peak is not None else None
+        ),
+        "page_exhausted": last.get("page_exhausted"),
+    }
+
+
 def summarize_serve(records: list[dict]) -> dict | None:
     """Fold ``serve_request`` records into per-bucket latency percentiles
     plus aggregate serving stats; None when the stream holds none."""
@@ -273,6 +300,7 @@ def summarize_serve(records: list[dict]) -> dict | None:
         "ttft_s": _pcts([r.get("ttft_s") for r in done]),
         "tpot_s": _pcts([r.get("tpot_s") for r in done]),
         "buckets": buckets,
+        "paged": summarize_paged(records),
     }
 
 
@@ -419,6 +447,21 @@ def render_serve_table(serve: dict) -> str:
         f"tokens/s={_fmt(serve.get('tokens_per_s'))} "
         f"queue-wait p95={_fmt(ms(qw, 'p95') if qw else None)}ms"
     )
+    paged = serve.get("paged")
+    if paged:
+        if paged.get("kv_layout") == "paged":
+            lines.append(
+                f"kv-cache: paged (page={_fmt(paged.get('page_size'))} tok, "
+                f"pool={_fmt(paged.get('pages_total'))} pages, "
+                f"peak={_fmt(paged.get('pages_peak'))} "
+                f"[{_fmt(paged.get('peak_occupancy_pct'), '.1f')}%]) "
+                f"sampling={paged.get('sampling')} "
+                f"page-exhausted={_fmt(paged.get('page_exhausted'))}"
+            )
+        else:
+            lines.append(
+                f"kv-cache: dense  sampling={paged.get('sampling')}"
+            )
     return "\n".join(lines)
 
 

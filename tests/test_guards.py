@@ -395,12 +395,17 @@ def test_serve_retrace_violation_fails_loop_and_records():
         assert wait_until(lambda: first.done.is_set(), timeout=120)
         assert first.status == "done"
 
-        # sabotage: drop the cache's trailing sequence position (axis 2 of
-        # the [slots, 1, cache_len, heads, head_dim] leaves) so the warmed
-        # prefill program sees a NEW shape -> guarded retrace
+        # sabotage: shrink the resident KV state so the warmed programs see
+        # a NEW shape -> guarded retrace. Paged layout (default): drop a
+        # page from the [num_pages, page_size, heads, head_dim] pools;
+        # dense layout: drop the trailing sequence position (axis 2 of the
+        # [slots, 1, cache_len, heads, head_dim] leaves).
         engine = server.engine
         engine._cache = jax.tree.map(
-            lambda g: g[:, :, :-1] if g.ndim == 5 else g, engine._cache
+            lambda g: (
+                g[:-1] if g.ndim == 4 else g[:, :, :-1] if g.ndim == 5 else g
+            ),
+            engine._cache,
         )
         second = server.submit(prompt, max_new_tokens=4)
         assert wait_until(lambda: second.done.is_set(), timeout=120)

@@ -1,0 +1,646 @@
+"""Paged KV cache + on-device sampling tests (serve/paged_cache.py,
+serve/sampling.py, ops/paged_attention.py and their engine integration):
+allocator lifecycle, page-budget admission backpressure, block-table
+attention pins (reference vs dense formula, pallas-interpret vs reference),
+the device-sampler's bit-exactness pin against the host sampler, engine
+token-identity (paged vs dense vs one-shot generate, device vs host
+sampling), mixed-context serving below dense-equivalent memory, and the
+strict tick-wide transfer scope. CPU, tier-1 (except the perf-marked
+BENCH_paged gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.models.generate import generate
+from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+from pytorch_distributed_training_tpu.ops.paged_attention import (
+    paged_attention,
+)
+from pytorch_distributed_training_tpu.serve import (
+    EngineConfig,
+    InferenceServer,
+)
+from pytorch_distributed_training_tpu.serve.paged_cache import (
+    PageAllocator,
+    strip_tables,
+    with_tables,
+)
+from pytorch_distributed_training_tpu.serve.sampling import device_sample
+from pytorch_distributed_training_tpu.serve.server import wait_until
+from pytorch_distributed_training_tpu.utils.config import model_preset
+
+pytestmark = pytest.mark.serve
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class ListSink:
+    """In-memory telemetry sink (same contract as JsonlSink.emit)."""
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        self.records.append(rec)
+
+    def flush(self, **kw):
+        pass
+
+    def of(self, kind):
+        return [r for r in self.records if r.get("record") == kind]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((2, 16), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+def _registry():
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    sink = ListSink()
+    reg.attach_sink(sink)
+    return reg, sink
+
+
+def _prompts(model, lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, model.config.vocab_size, n).astype(np.int32)
+        for n in lengths
+    ]
+
+
+# --------------------------------------------------------------- allocator
+
+
+def test_allocator_alloc_free_reuse():
+    alloc = PageAllocator(
+        num_pages=9, page_size=4, pages_per_slot=3, num_slots=2
+    )
+    assert alloc.pages_free == 8 and alloc.pages_used == 0
+
+    alloc.admit(0, 3)
+    assert alloc.pages_used == 3 and alloc.pages_free == 5
+    first = alloc.slot_pages(0)
+    assert len(first) == 3 and 0 not in first
+    np.testing.assert_array_equal(alloc.block_table[0], np.asarray(first))
+
+    alloc.admit(1, 2)
+    assert alloc.pages_used == 5
+    # disjoint ownership, never the null page
+    assert not set(first) & set(alloc.slot_pages(1))
+
+    alloc.release(0)
+    assert alloc.pages_used == 2 and alloc.pages_free == 6
+    assert alloc.slot_pages(0) == ()
+    np.testing.assert_array_equal(alloc.block_table[0], 0)
+
+    # LIFO free list: the just-freed pages are re-handed first (hot set
+    # stays small), in the same order the slot originally held them
+    alloc.admit(0, 3)
+    assert alloc.slot_pages(0) == first
+    assert alloc.peak_used == 5
+
+
+def test_allocator_exhaustion_backpressure_and_misuse():
+    alloc = PageAllocator(
+        num_pages=5, page_size=4, pages_per_slot=4, num_slots=2
+    )
+    assert alloc.can_alloc(4) and not alloc.can_alloc(5)
+    alloc.admit(0, 3)
+    assert not alloc.can_alloc(2)       # 1 free page left
+    with pytest.raises(RuntimeError, match="exhausted"):
+        alloc.admit(1, 2)
+    with pytest.raises(RuntimeError, match="already holds"):
+        alloc.admit(0, 1)
+    with pytest.raises(ValueError, match="block-table rows"):
+        alloc.admit(1, 5)
+    # a failed admit must not leak or corrupt anything
+    assert alloc.pages_used == 3 and alloc.can_alloc(1)
+
+    # release is idempotent and returns everything
+    alloc.release(0)
+    alloc.release(0)
+    assert alloc.pages_free == 4 and alloc.pages_used == 0
+
+    # ceil-division page budget
+    assert alloc.pages_needed(1) == 1
+    assert alloc.pages_needed(4) == 1
+    assert alloc.pages_needed(5) == 2
+    assert alloc.pages_needed(0) == 1   # a slot always needs one page
+
+
+def test_allocator_rejects_degenerate_shapes():
+    with pytest.raises(ValueError, match="page_size"):
+        PageAllocator(num_pages=4, page_size=0, pages_per_slot=1, num_slots=1)
+    with pytest.raises(ValueError, match="num_pages"):
+        PageAllocator(num_pages=1, page_size=4, pages_per_slot=1, num_slots=1)
+    with pytest.raises(ValueError, match="pages_per_slot"):
+        PageAllocator(num_pages=4, page_size=4, pages_per_slot=0, num_slots=1)
+
+
+def test_with_tables_strip_tables_roundtrip():
+    pools = {
+        "layers_0": {"attn": {"k_pages": "K0", "v_pages": "V0"}},
+        "layers_1": {"attn": {"k_pages": "K1", "v_pages": "V1"}},
+    }
+    full = with_tables(pools, "BT", "CL")
+    for layer in ("layers_0", "layers_1"):
+        node = full[layer]["attn"]
+        assert node["block_table"] == "BT" and node["context_len"] == "CL"
+    assert strip_tables(full) == pools
+    # the original pools tree is untouched (with_tables builds a new dict)
+    assert "block_table" not in pools["layers_0"]["attn"]
+
+
+# ----------------------------------------------------- page-budget admission
+
+
+def test_pop_ready_accept_predicate_is_strict_fifo():
+    from pytorch_distributed_training_tpu.serve.queue import (
+        GenRequest,
+        RequestQueue,
+    )
+
+    q = RequestQueue(max_depth=8, prompt_buckets=(4, 8), max_new_tokens=4)
+    big = q.submit(GenRequest(
+        id="big", prompt_ids=np.ones(7, np.int32), max_new_tokens=4,
+    ))
+    q.submit(GenRequest(
+        id="small", prompt_ids=np.ones(3, np.int32), max_new_tokens=4,
+    ))
+
+    # the earliest-submitted head (big) fails the predicate: pop_ready
+    # must return None — the small request may NOT slip past it
+    assert q.pop_ready(accept=lambda r: r.bucket <= 4) is None
+    assert q.depth() == 2
+
+    # once the head is accepted, submission order resumes
+    assert q.pop_ready(accept=lambda r: True) is big
+    assert q.pop_ready().id == "small"
+    assert q.pop_ready() is None
+
+
+# ------------------------------------------------------- paged attention op
+
+
+def _paged_fixture(seed=0, batch=3, heads=2, head_dim=4, page_size=4,
+                   windows=3, num_pages=16):
+    """Random contiguous K/V scattered into a noise-filled page pool via a
+    shuffled block table, plus the dense [B, T, H, D] mirror."""
+    rng = np.random.default_rng(seed)
+    T = page_size * windows
+    q = rng.standard_normal((batch, heads, head_dim)).astype(np.float32)
+    k = rng.standard_normal((batch, T, heads, head_dim)).astype(np.float32)
+    v = rng.standard_normal((batch, T, heads, head_dim)).astype(np.float32)
+    # pools start as GARBAGE, not zeros: masked lanes must be excluded by
+    # the length mask alone, never by relying on zeroed storage
+    k_pages = rng.standard_normal(
+        (num_pages, page_size, heads, head_dim)
+    ).astype(np.float32)
+    v_pages = rng.standard_normal(
+        (num_pages, page_size, heads, head_dim)
+    ).astype(np.float32)
+    ids = rng.permutation(np.arange(1, num_pages))[: batch * windows]
+    block_table = ids.reshape(batch, windows).astype(np.int32)
+    for b in range(batch):
+        for w in range(windows):
+            k_pages[block_table[b, w]] = k[b, w * page_size:(w + 1) * page_size]
+            v_pages[block_table[b, w]] = v[b, w * page_size:(w + 1) * page_size]
+    lengths = np.asarray([1, T - 3, T], np.int32)[:batch]
+    return q, k, v, k_pages, v_pages, block_table, lengths
+
+
+def _dense_formula(q, k, v, lengths, scale):
+    """The exact fp32-softmax formula models/bert.py uses on the dense
+    cache path, applied to contiguous K/V."""
+    scores = jnp.einsum(
+        "bnd,btnd->bnt", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    scores = jnp.where(
+        pos < lengths[:, None, None], scores, jnp.finfo(jnp.float32).min
+    )
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bnt,btnd->bnd", probs, v)
+
+
+def test_paged_reference_bitwise_matches_dense_formula():
+    q, k, v, k_pages, v_pages, bt, lengths = _paged_fixture()
+    scale = q.shape[-1] ** -0.5
+    want = _dense_formula(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(lengths), scale,
+    )
+    got = paged_attention(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(bt), jnp.asarray(lengths),
+        scale=scale, impl="reference",
+    )
+    # bitwise: the gather through the block table reassembles the same
+    # contiguous K/V, the masked (garbage) lanes contribute exact zeros
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_paged_pallas_interpret_matches_reference():
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        tpu_interpret_mode,
+    )
+
+    q, k, v, k_pages, v_pages, bt, lengths = _paged_fixture(seed=5)
+    scale = q.shape[-1] ** -0.5
+    ref = paged_attention(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(bt), jnp.asarray(lengths),
+        scale=scale, impl="reference",
+    )
+    with tpu_interpret_mode():
+        got = paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(bt), jnp.asarray(lengths),
+            scale=scale, impl="pallas",
+        )
+    # online softmax reorders the reduction: tight allclose, not bitwise
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_paged_attention_validates_shapes():
+    q, k, v, k_pages, v_pages, bt, lengths = _paged_fixture()
+    with pytest.raises(ValueError):
+        paged_attention(
+            jnp.asarray(q)[:, None], jnp.asarray(k_pages),
+            jnp.asarray(v_pages), jnp.asarray(bt), jnp.asarray(lengths),
+            scale=1.0,
+        )
+    with pytest.raises(ValueError):
+        paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(bt), jnp.asarray(lengths)[:-1], scale=1.0,
+        )
+
+
+# ----------------------------------------------------------------- sampling
+
+
+def test_device_sample_bitwise_matches_host_sampler():
+    """serve/sampling.device_sample is the in-jit mirror of the engine's
+    host ``_sample``: same token id for every (temperature, top_k, seed,
+    step) cell, including greedy ties, k=0 (no truncation), k=1 and
+    k >= vocab."""
+    from pytorch_distributed_training_tpu.serve.engine import DecodeEngine
+    from pytorch_distributed_training_tpu.serve.queue import GenRequest
+
+    vocab = 32
+    rng = np.random.default_rng(0)
+    cases = [
+        (0.0, 0), (0.0, 5),             # greedy ignores top_k
+        (0.7, 0), (0.7, 5), (1.3, 1),
+        (0.9, vocab + 100),             # oversized k = no truncation
+    ]
+    for seed in (0, 11):
+        for step in (0, 1, 5):
+            logits = rng.standard_normal((len(cases), vocab)).astype(
+                np.float32
+            )
+            logits[0, 3] = logits[0, 7] = logits[0].max() + 1.0  # greedy tie
+            temps = np.asarray([t for t, _ in cases], np.float32)
+            top_ks = np.asarray([k for _, k in cases], np.int32)
+            got = np.asarray(device_sample(
+                jnp.asarray(logits),
+                jnp.full((len(cases),), seed, jnp.int32),
+                jnp.full((len(cases),), step, jnp.int32),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+            ))
+            for i, (temp, top_k) in enumerate(cases):
+                req = GenRequest(
+                    id="x", prompt_ids=np.ones(1, np.int32),
+                    max_new_tokens=8, temperature=temp, top_k=top_k,
+                    seed=seed,
+                )
+                req.tokens = [0] * step     # host folds in len(req.tokens)
+                want = DecodeEngine._sample(None, req, logits[i])
+                assert int(got[i]) == want, (temp, top_k, seed, step)
+
+
+# --------------------------------------------------------- engine identity
+
+
+def _run_server(model, params, prompts, T, *, kv_layout, sampling,
+                temperature=0.0, top_k=0, seed=0, **cfg_kw):
+    reg, sink = _registry()
+    server = InferenceServer(
+        model, params,
+        EngineConfig(
+            num_slots=2, prompt_buckets=(4, 8, 16), max_new_tokens=T,
+            kv_layout=kv_layout, sampling=sampling, **cfg_kw,
+        ),
+        queue_depth=16, registry=reg,
+    ).start()
+    try:
+        reqs = [
+            server.submit(
+                p, max_new_tokens=T, temperature=temperature, top_k=top_k,
+                seed=seed + i,
+            )
+            for i, p in enumerate(prompts)
+        ]
+        assert wait_until(
+            lambda: all(r.done.is_set() for r in reqs), timeout=120
+        )
+    finally:
+        server.close()
+    assert all(r.status == "done" for r in reqs), [r.status for r in reqs]
+    return [np.asarray(r.tokens, np.int32) for r in reqs], server.stats()
+
+
+def test_paged_greedy_token_identical_to_dense_and_generate(lm):
+    """Acceptance pin: the paged engine's greedy continuations are
+    bit-identical to the dense engine's AND to one-shot generate() at the
+    exact prompt length."""
+    model, params = lm
+    T = 5
+    prompts = _prompts(model, [3, 6, 9, 14, 5], seed=7)
+    want = [
+        np.asarray(generate(model, params, p[None], max_new_tokens=T))[
+            0, len(p):
+        ]
+        for p in prompts
+    ]
+    paged, pstats = _run_server(
+        model, params, prompts, T, kv_layout="paged", sampling="device",
+    )
+    dense, dstats = _run_server(
+        model, params, prompts, T, kv_layout="dense", sampling="host",
+    )
+    for i, (p_toks, d_toks, ref) in enumerate(zip(paged, dense, want)):
+        np.testing.assert_array_equal(p_toks, ref, err_msg=f"paged req {i}")
+        np.testing.assert_array_equal(d_toks, ref, err_msg=f"dense req {i}")
+    assert pstats["kv_layout"] == "paged" and pstats["kv_pages_peak"] > 0
+    assert dstats["kv_layout"] == "dense" and dstats["kv_pages_total"] is None
+
+
+def test_sampled_device_matches_host_under_fixed_seed(lm):
+    """Fixed-key sampled decode is exact across the sampling location AND
+    the cache layout: paged+device == dense+host, token for token."""
+    model, params = lm
+    T = 6
+    prompts = _prompts(model, [3, 7, 12], seed=3)
+    kw = dict(temperature=0.8, top_k=5, seed=11)
+    device_toks, _ = _run_server(
+        model, params, prompts, T, kv_layout="paged", sampling="device", **kw
+    )
+    host_toks, _ = _run_server(
+        model, params, prompts, T, kv_layout="dense", sampling="host", **kw
+    )
+    for i, (d, h) in enumerate(zip(device_toks, host_toks)):
+        assert len(d) == T
+        np.testing.assert_array_equal(d, h, err_msg=f"request {i}")
+
+
+def test_page_exhaustion_backpressure_never_hangs(lm):
+    """A pool holding ONE worst-case request at a time still drains a
+    6-request burst: admission blocks on pages (page_exhausted ticks up),
+    never wedges, and every answer is still greedy-exact."""
+    model, params = lm
+    T = 8
+    prompts = _prompts(model, [8, 5, 8, 6, 7, 8], seed=1)
+    want = [
+        np.asarray(generate(model, params, p[None], max_new_tokens=T))[
+            0, len(p):
+        ]
+        for p in prompts
+    ]
+    reg, sink = _registry()
+    # pages_per_slot = ceil((8+8)/4) = 4; num_pages=5 leaves 4 usable —
+    # exactly one worst-case request's budget, despite 4 slots
+    server = InferenceServer(
+        model, params,
+        EngineConfig(
+            num_slots=4, prompt_buckets=(8,), max_new_tokens=T,
+            kv_layout="paged", sampling="device", page_size=4, num_pages=5,
+        ),
+        queue_depth=8, registry=reg,
+    ).start()
+    try:
+        reqs = [server.submit(p, max_new_tokens=T) for p in prompts]
+        assert wait_until(
+            lambda: all(r.done.is_set() for r in reqs), timeout=120
+        ), [r.status for r in reqs]
+    finally:
+        server.close()
+    for i, (req, ref) in enumerate(zip(reqs, want)):
+        assert req.status == "done"
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens, np.int32), ref, err_msg=f"request {i}"
+        )
+    stats = server.stats()
+    assert stats["page_exhausted"] > 0
+    assert stats["kv_pages_used"] == 0 and stats["kv_pages_peak"] <= 4
+    # eviction returned every page to the pool
+    assert stats["kv_pages_free"] == 4
+
+
+def test_mixed_context_pool_below_dense_equivalent(lm):
+    """One paged engine admits a 1x-8x mixed-context workload through a
+    pool SMALLER than num_slots x longest-context — the shape the dense
+    layout cannot configure at equal memory (it charges every slot the
+    longest context) — and stays greedy-exact including the longest
+    request."""
+    model, params = lm
+    T = 4
+    lengths = [3, 4, 26, 32, 4, 20]
+    prompts = _prompts(model, lengths, seed=9)
+    want = [
+        np.asarray(generate(model, params, p[None], max_new_tokens=T))[
+            0, len(p):
+        ]
+        for p in prompts
+    ]
+    reg, sink = _registry()
+    page_size = 4
+    pages_per_slot = -(-(32 + T) // page_size)          # 9
+    dense_equiv = 4 * pages_per_slot                    # 36 usable pages
+    num_pages = 20                                      # 19 usable < 36
+    server = InferenceServer(
+        model, params,
+        EngineConfig(
+            num_slots=4, prompt_buckets=(4, 32), max_new_tokens=T,
+            kv_layout="paged", sampling="device",
+            page_size=page_size, num_pages=num_pages,
+        ),
+        queue_depth=8, registry=reg,
+    ).start()
+    try:
+        reqs = [server.submit(p, max_new_tokens=T) for p in prompts]
+        assert wait_until(
+            lambda: all(r.done.is_set() for r in reqs), timeout=120
+        ), [r.status for r in reqs]
+    finally:
+        server.close()
+    for i, (req, ref) in enumerate(zip(reqs, want)):
+        assert req.status == "done"
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens, np.int32), ref,
+            err_msg=f"request {i} (len {lengths[i]})",
+        )
+    stats = server.stats()
+    assert stats["kv_pages_total"] == num_pages - 1 < dense_equiv
+    peak = stats["kv_pages_peak"]
+    assert peak > 0 and peak <= num_pages - 1
+    # the per-tick pool gauges landed in the registry
+    gauges = reg.snapshot()["gauges"]
+    assert "serve/kv_pages_used" in gauges
+    assert "serve/kv_pages_free" in gauges
+    assert gauges["serve/kv_pages_used"] == 0.0  # everything evicted
+
+
+# ------------------------------------------------- strict tick-wide scope
+
+
+def test_strict_tick_scope_two_buckets_zero_implicit_transfers(lm):
+    """Acceptance: with warmup=True every compiled program is warm before
+    the first real tick, so the WHOLE tick body runs under
+    transfer_guard("disallow") from request one — a 2-bucket mixed
+    greedy/sampled session records ZERO implicit transfers and zero
+    recompiles in strict mode."""
+    from pytorch_distributed_training_tpu.analysis.guards import GuardSet
+
+    model, params = lm
+    reg, sink = _registry()
+    gs = GuardSet(mode="strict", registry=reg)
+    server = InferenceServer(
+        model, params,
+        EngineConfig(
+            num_slots=2, prompt_buckets=(4, 8), max_new_tokens=4,
+            kv_layout="paged", sampling="device", warmup=True,
+        ),
+        queue_depth=16, registry=reg, guards=gs,
+    ).start()
+    try:
+        rng = np.random.default_rng(3)
+        reqs = []
+        for i, n in enumerate([3, 6, 2, 7, 4, 5]):
+            reqs.append(server.submit(
+                rng.integers(1, model.config.vocab_size, n).astype(np.int32),
+                max_new_tokens=4,
+                temperature=0.8 if i % 2 else 0.0, top_k=3, seed=i,
+            ))
+        assert wait_until(
+            lambda: all(r.done.is_set() for r in reqs), timeout=120
+        )
+    finally:
+        server.close()
+
+    assert all(r.status == "done" for r in reqs)
+    stats = server.stats()
+    assert stats["compiled_prefill_buckets"] == [4, 8]
+    assert stats["guard_mode"] == "strict"
+    assert stats["guard_recompiles"] == 0
+    assert stats["guard_implicit_transfers"] == 0
+    assert not sink.of("recompile") and not sink.of("implicit_transfer")
+    for name in ("serve_prefill_b4", "serve_prefill_b8", "serve_decode"):
+        assert gs.wrapped[name].calls >= 2, name
+
+
+# ------------------------------------------------- periodic lock summaries
+
+
+@pytest.mark.concurrency
+def test_periodic_lock_summary_emits_on_cadence_and_stops():
+    from pytorch_distributed_training_tpu.analysis.concurrency import (
+        start_periodic_summary,
+    )
+    from pytorch_distributed_training_tpu.analysis.concurrency.locks import (
+        LockRegistry,
+        lock,
+    )
+
+    reg, sink = _registry()
+    lr = LockRegistry(mode="record")
+    with lock("test.periodic", registry=lr):
+        pass
+    ps = start_periodic_summary(0.02, registry=reg, lock_registry=lr)
+    try:
+        deadline = time.monotonic() + 10
+        while ps.emitted < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        ps.stop()
+    stopped_at = ps.emitted
+    assert stopped_at >= 3
+    recs = sink.of("lock_summary")
+    assert len(recs) >= 3
+    assert all("test.periodic" in r["locks"] for r in recs)
+    # stop() is bounded, idempotent, and halts emission
+    ps.stop()
+    time.sleep(0.08)
+    assert ps.emitted == stopped_at
+
+    with pytest.raises(ValueError, match="interval_s"):
+        start_periodic_summary(0.0, registry=reg, lock_registry=lr)
+
+
+# ------------------------------------------------------------ perf gate
+
+
+@pytest.mark.perf
+def test_paged_bench_device_sampling_beats_dense_host(tmp_path):
+    """bench.py --paged: on the UNIFORM workload the paged cache + on-device
+    sampling must sustain at least the dense cache + host sampling's
+    tokens/sec (the PR's perf acceptance gate), and the mixed workload must
+    run through a page pool smaller than the dense-equivalent allocation."""
+    out = tmp_path / "BENCH_paged.json"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+            "--paged", "--paged-out", str(out),
+        ],
+        capture_output=True, text=True, timeout=1200, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(out.read_text())
+
+    uni = result["uniform"]
+    assert uni["dense_host"]["kv_layout"] == "dense"
+    assert uni["paged_device"]["kv_layout"] == "paged"
+    # same workload on both sides
+    assert uni["dense_host"]["tokens"] == uni["paged_device"]["tokens"]
+    # the gate: paged + device sampling >= dense + host sampling
+    assert (
+        uni["paged_device"]["tokens_per_s"]
+        >= uni["dense_host"]["tokens_per_s"]
+    ), result
+    assert uni["speedup"] >= 1.0
+
+    mixed = result["mixed"]
+    assert mixed["pool_below_dense_equiv"] is True
+    assert mixed["paged_device"]["requests"] == 16
+    for block in ("ttft_s", "tpot_s"):
+        stats = mixed["paged_device"][block]
+        assert stats["count"] > 0
+        assert stats["p50"] <= stats["p95"] <= stats["p99"]
