@@ -1431,6 +1431,7 @@ def _quick_stats(metrics_dir: str) -> dict:
     compile_rec = next(
         (r for r in records if r.get("record") == "compile"), None
     )
+    comm = [r for r in records if r.get("record") == "comm_audit"]
     return {
         "steps": len(steps),
         "steady_steps": len(steady),
@@ -1442,6 +1443,12 @@ def _quick_stats(metrics_dir: str) -> dict:
         "compile_inclusive_steps": sum(
             1 for s in steps if s.get("compile_inclusive")
         ),
+        "comm_audit": {
+            "audits": len(comm),
+            "ok": all(r.get("ok") is not False for r in comm),
+            "collectives": sum(r.get("count", 0) for r in comm),
+            "total_bytes": sum(r.get("total_bytes", 0) for r in comm),
+        },
     }
 
 
@@ -1497,6 +1504,16 @@ def run_quick(steps: int = 24, global_batch: int = 64,
             "cold_compile_s": off["compile_s"],
             "warm_compile_s": on["compile_s"],
             "cache_hit_second_run": on["cache_hit"],
+        },
+        "comm_audit": {
+            # warm-start manifest audit per variant: a single-CPU-device
+            # quick run must stay collective-free end to end
+            "audits": off["comm_audit"]["audits"] + on["comm_audit"]["audits"],
+            "ok": off["comm_audit"]["ok"] and on["comm_audit"]["ok"],
+            "collectives": (
+                off["comm_audit"]["collectives"]
+                + on["comm_audit"]["collectives"]
+            ),
         },
     }
     if out_path:

@@ -23,6 +23,13 @@ source; this module catches what only shows up live:
   above-threshold leaves left fully replicated while the mesh has
   non-trivial fsdp/model/stage axes (a sharding policy that silently
   didn't apply), as a ``sharding_audit`` record.
+- **Collective audit** — ``wrap_jit(..., comm_manifest=...)`` checks the
+  warmed program's compiled HLO against an expected-collective manifest
+  (``analysis/spmd/manifest.py``): post-first-compile the call re-lowers
+  AND re-compiles against the warm-up avals, extracts every collective,
+  and ``comm_audit`` emits a ``comm_audit`` record (strict: raises on
+  deviation). Opt-in per call site — the extra compile is real money, so
+  only deliberately-warmed programs pass a manifest.
 
 Modes (``PDT_TPU_GUARDS`` env or ``TrainConfig.guards`` / serve
 ``--guards``): ``off`` — pass-through; ``record`` (default) — detect,
@@ -125,12 +132,13 @@ class GuardedCall:
     never legally trace; a jit gets exactly one warm-up call."""
 
     def __init__(self, name: str, fn, guards: "GuardSet",
-                 audit_donation: bool = False):
+                 audit_donation: bool = False, comm_manifest=None):
         self.name = name
         self.fn = fn
         self.guards = guards
         self._warm = not hasattr(fn, "_cache_size")
         self._audit_donation = audit_donation
+        self._comm_manifest = comm_manifest
         self.calls = 0
         self.recompiles = 0
 
@@ -160,6 +168,34 @@ class GuardedCall:
             registry=self.guards.registry, mode=self.guards.mode,
         )
 
+    def _comm_audit_from(self, args, kwargs) -> None:
+        """Post-first-call collective audit. Unlike the donation audit
+        this needs the COMPILED program (SPMD-partitioner collectives
+        don't exist in the lowering), so it re-lowers AND re-compiles
+        against the warm-up avals — acceptable only because manifests are
+        opt-in at the wrap site."""
+        from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
+            comm_audit,
+        )
+
+        try:
+            specs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (args, dict(kwargs)),
+            )
+            compiled = self.fn.lower(*specs[0], **specs[1]).compile()
+        except Exception as e:  # pragma: no cover - lowering quirk
+            self.guards.registry.emit({
+                "record": "comm_audit", "name": self.name,
+                "manifest": self._comm_manifest.name, "ok": None,
+                "error": str(e)[:200],
+            })
+            return
+        comm_audit(
+            self.name, compiled, self._comm_manifest,
+            registry=self.guards.registry, mode=self.guards.mode,
+        )
+
     def __call__(self, *args, **kwargs):
         g = self.guards
         if g.mode == "off":
@@ -183,6 +219,8 @@ class GuardedCall:
             self._warm = True  # the one expected warm-up compile
             if self._audit_donation:
                 self._donation_audit_from(args, kwargs)
+            if self._comm_manifest is not None:
+                self._comm_audit_from(args, kwargs)
         elif traced:
             self.recompiles += 1
             g._recompile_violation(self, traced)
@@ -214,14 +252,22 @@ class GuardSet:
 
     # ------------------------------------------------------------- wrapping
 
-    def wrap_jit(self, name: str, fn, *, audit_donation: bool = False):
+    def wrap_jit(self, name: str, fn, *, audit_donation: bool = False,
+                 comm_manifest=None):
         """Wrap a jitted (or AOT-compiled) callable; idempotent. With
         ``audit_donation`` the first (warm-up) call also audits that the
         donation requested at jit time survived to the executable —
-        the serve programs\' post-first-compile hook."""
+        the serve programs\' post-first-compile hook. With
+        ``comm_manifest`` (a ``spmd.CommManifest``) the first call also
+        audits the compiled program\'s collective footprint against its
+        manifest — at the cost of one extra compile, so pass it only on
+        deliberately-warmed programs."""
         if isinstance(fn, GuardedCall):
             return fn
-        wrapped = GuardedCall(name, fn, self, audit_donation=audit_donation)
+        wrapped = GuardedCall(
+            name, fn, self,
+            audit_donation=audit_donation, comm_manifest=comm_manifest,
+        )
         self.wrapped[name] = wrapped
         return wrapped
 

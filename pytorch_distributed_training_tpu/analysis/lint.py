@@ -17,7 +17,7 @@ import ast
 import dataclasses
 import os
 
-from pytorch_distributed_training_tpu.analysis.rules import ALL_RULES
+from pytorch_distributed_training_tpu.analysis.rules import ALL_RULES, _ids
 from pytorch_distributed_training_tpu.analysis.rules.common import (
     Finding,
     ModuleContext,
@@ -52,6 +52,19 @@ class LintReport:
         for f in self.findings:
             out[f.rule] = out.get(f.rule, 0) + 1
         return out
+
+
+def select_rules(rule_ids) -> tuple:
+    """Rule modules reporting any of ``rule_ids`` (``--rules`` filter).
+    Raises ``ValueError`` on an id no registered rule reports."""
+    wanted = set(rule_ids)
+    known = {rid for mod in ALL_RULES for rid in _ids(mod)}
+    unknown = sorted(wanted - known)
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s) {unknown}; known: {sorted(known)}"
+        )
+    return tuple(m for m in ALL_RULES if wanted & set(_ids(m)))
 
 
 def _rel(path: str) -> str:
@@ -96,7 +109,14 @@ def lint_paths(
     paths: list[str],
     waivers: list[Waiver] | None = None,
     rules=ALL_RULES,
+    rule_ids=None,
 ) -> LintReport:
+    """With ``rule_ids`` (the ``--rules`` filter) only those finding ids
+    are reported, and only waivers owned by them can count as unused — a
+    subset run must not flag other rules' waivers as dead."""
+    if rule_ids is not None:
+        rules = select_rules(rule_ids)
+        waivers = [w for w in (waivers or []) if w.rule in set(rule_ids)]
     waivers = list(waivers or [])
     all_findings: list[Finding] = []
     errors: list[str] = []
@@ -108,6 +128,10 @@ def lint_paths(
             all_findings.extend(lint_source(source, _rel(fpath), rules))
         except (SyntaxError, UnicodeDecodeError) as e:
             errors.append(f"{_rel(fpath)}: unparseable: {e}")
+    if rule_ids is not None:
+        # a module selected for one of its ids reports ALL its ids —
+        # narrow to exactly what was asked for
+        all_findings = [f for f in all_findings if f.rule in set(rule_ids)]
 
     active: list[Finding] = []
     waived: list[tuple[Finding, Waiver]] = []
@@ -136,6 +160,11 @@ def summary_record(report: LintReport) -> dict:
         "findings": len(report.findings),
         "waived": len(report.waived),
         "unused_waivers": len(report.unused_waivers),
+        # the owning rule ids, so a dead suppression is findable from the
+        # telemetry stream alone
+        "unused_waiver_rules": sorted(
+            {w.rule for w in report.unused_waivers}
+        ),
         "parse_errors": len(report.errors),
         "by_rule": report.by_rule(),
         "clean": report.clean,

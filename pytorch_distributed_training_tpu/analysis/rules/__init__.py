@@ -10,7 +10,11 @@ The JAX rules (traced-branch … mutable-default) came with PR 5; the
 concurrency rules (thread_shared, lock_discipline, thread_lifecycle)
 lint the hand-rolled threaded surface — serve loop, router, fleet,
 hotswap watcher, prefetch, telemetry sink — against the race/deadlock/
-shutdown-hang classes documented in each module.
+shutdown-hang classes documented in each module; the spmd rules
+(pspec-mismatch, shardmap-axis-misuse, collective-in-loop,
+implicit-replication) lint the sharding surface — PartitionSpec/
+shard_map call sites and traced-scope array inits — against the silent
+replication/unbound-axis classes ``analysis/spmd/`` audits at runtime.
 """
 
 from pytorch_distributed_training_tpu.analysis.rules import (
@@ -20,6 +24,7 @@ from pytorch_distributed_training_tpu.analysis.rules import (
     lock_discipline,
     mutable_default,
     prng_reuse,
+    spmd,
     thread_lifecycle,
     thread_shared,
     traced_branch,
@@ -36,6 +41,7 @@ ALL_RULES = (
     donation,
     prng_reuse,
     mutable_default,
+    spmd,
     thread_shared,
     lock_discipline,
     thread_lifecycle,

@@ -60,6 +60,10 @@ _TRACING_CALLABLES = {
     "jax.lax.fori_loop",
     "shard_map",
     "jax.experimental.shard_map.shard_map",
+    # the repo's normalized wrapper (ops/dispatch.py) — same trace scope
+    "pytorch_distributed_training_tpu.ops.dispatch.shard_map",
+    "ops.dispatch.shard_map",
+    "dispatch.shard_map",
 }
 
 # jit-ish names valid as decorators (bare or via functools.partial)

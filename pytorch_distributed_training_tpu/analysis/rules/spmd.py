@@ -1,0 +1,337 @@
+"""SPMD sharding rules: ``pspec-mismatch``, ``shardmap-axis-misuse``,
+``collective-in-loop``, ``implicit-replication``.
+
+Sharding bugs are the quietest class in this codebase: a PartitionSpec
+naming an axis the mesh doesn't have simply replicates, a psum over an
+unbound axis fails only when first traced on a multi-chip mesh, a
+per-iteration collective inside ``lax.scan`` multiplies ICI traffic by
+the scan length, and a full-shape ``jnp.zeros`` inside jit materializes
+replicated on every device of a sharded mesh. None of them throw on the
+single-device CPU path the tests run on — so they get static rules:
+
+- **pspec-mismatch** — a ``PartitionSpec``/``P`` literal naming an axis
+  outside the canonical mesh universe (``MeshConfig.AXIS_NAMES`` +
+  ``seq``), or naming the same axis for two different dims (XLA rejects
+  an axis used twice; the typo variant shards the wrong dim silently).
+- **shardmap-axis-misuse** — a named-axis collective (``psum`` et al.)
+  whose axis literal is outside the canonical universe, or issued from a
+  traced function that is NOT bound by ``shard_map``/``pmap`` (including
+  the normalized ``ops/dispatch.shard_map``) — under plain jit there is
+  no axis environment and the first multi-chip trace dies.
+- **collective-in-loop** — a collective issued per-iteration inside a
+  ``lax.scan``/``fori_loop``/``while_loop`` body or a host ``for``/
+  ``while`` loop; a batched post-loop reduction moves the same data once
+  (ring algorithms that permute per step — ring attention — get
+  waivers, which is the point: the exception is written down).
+- **implicit-replication** — a large (>= ``_MIN_ELEMENTS`` elements)
+  full-shape array init (``jnp.zeros``-style) with a literal shape
+  inside a traced function: the SPMD partitioner materializes it fully
+  replicated unless a sharding constraint says otherwise — create it
+  outside jit and ``device_put`` with a ``NamedSharding`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from pytorch_distributed_training_tpu.analysis.rules.common import (
+    Finding,
+    ModuleContext,
+    walk_body,
+)
+
+PSPEC_RULE_ID = "pspec-mismatch"
+AXIS_RULE_ID = "shardmap-axis-misuse"
+LOOP_RULE_ID = "collective-in-loop"
+REPL_RULE_ID = "implicit-replication"
+
+RULE_IDS = (PSPEC_RULE_ID, AXIS_RULE_ID, LOOP_RULE_ID, REPL_RULE_ID)
+
+# The canonical mesh-axis universe: MeshConfig.AXIS_NAMES plus the `seq`
+# axis ring attention shards on. Kept as literals (the linter must parse
+# files without importing jax); test_analysis pins them against
+# utils.config.MeshConfig so drift fails loudly.
+CANONICAL_AXES = frozenset({"data", "fsdp", "stage", "model", "seq"})
+
+_PSPEC_CALLS = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "PartitionSpec",
+}
+
+#: named-axis collectives (+ axis_index, which needs the same binding)
+_COLLECTIVE_CALLS = {
+    "jax.lax.psum": 1, "psum": 1,
+    "jax.lax.pmean": 1, "pmean": 1,
+    "jax.lax.pmax": 1, "pmax": 1,
+    "jax.lax.pmin": 1, "pmin": 1,
+    "jax.lax.all_gather": 1, "all_gather": 1,
+    "jax.lax.all_to_all": 1, "all_to_all": 1,
+    "jax.lax.ppermute": 1, "ppermute": 1,
+    "jax.lax.pshuffle": 1, "pshuffle": 1,
+    "jax.lax.psum_scatter": 1, "psum_scatter": 1,
+    "jax.lax.axis_index": 0, "axis_index": 0,
+}
+
+#: callables binding a named-axis environment for their function arg
+_AXIS_BINDERS = {
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.shard_map",
+    "pytorch_distributed_training_tpu.ops.dispatch.shard_map",
+    "ops.dispatch.shard_map",
+    "dispatch.shard_map",
+    "jax.pmap",
+    "pmap",
+}
+
+#: combinators whose function arg re-runs per iteration
+_SCAN_CALLS = {
+    "jax.lax.scan",
+    "jax.lax.fori_loop",
+    "jax.lax.while_loop",
+    "jax.lax.map",
+}
+
+#: full-shape array creators (first arg is the shape)
+_CREATOR_CALLS = {
+    "jax.numpy.zeros", "jnp.zeros", "numpy.zeros",
+    "jax.numpy.ones", "jnp.ones", "numpy.ones",
+    "jax.numpy.full", "jnp.full", "numpy.full",
+    "jax.numpy.empty", "jnp.empty", "numpy.empty",
+}
+
+#: 64K elements = 256KB fp32 — below this, replication is noise
+_MIN_ELEMENTS = 1 << 16
+
+
+def _axis_literals(node: ast.AST) -> list:
+    """String literals in an axis-name position (str or tuple/list of
+    str); non-literals yield nothing — the rule skips what it can't see."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((elt.value, elt))
+    return out
+
+
+def _literal_elements(node: ast.AST) -> Optional[int]:
+    """Element count of a literal shape argument (int or tuple/list of
+    ints); None when any dim is not a literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return max(node.value, 0)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        total = 1
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant)
+                and isinstance(elt.value, int)
+            ):
+                return None
+            total *= max(elt.value, 0)
+        return total
+    return None
+
+
+def _functions_passed_to(ctx: ModuleContext, callables: set,
+                         follow_calls: bool = False) -> set:
+    """Functions passed (by name or lambda) as arg 0 to any of
+    ``callables``, closed over nesting. With ``follow_calls`` the set is
+    also closed over direct same-module calls: a helper invoked by name
+    from a bound function runs under the same axis environment (the
+    ``inner`` -> ``_inner_body`` indirection the pipeline and ring
+    kernels use). Only the axis-BINDING check follows calls — there,
+    over-approximating merely suppresses findings; for the scan-body
+    check it would invent per-iteration call sites that aren't."""
+    by_name: dict = {}
+    for f in ctx.functions():
+        by_name.setdefault(f.name, []).append(f)
+    bound: set = set()
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if ctx.resolve(call.func) not in callables:
+            continue
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name):
+                bound.update(by_name.get(arg.id, []))
+            elif isinstance(arg, ast.Lambda):
+                bound.add(arg)
+
+    def close(seed: set) -> set:
+        out = set(seed)
+        changed = True
+        while changed:
+            changed = False
+            # nested defs inherit the binding
+            for f in ctx.functions():
+                if f in out:
+                    continue
+                cur = ctx.parents.get(f)
+                while cur is not None:
+                    if cur in out:
+                        out.add(f)
+                        changed = True
+                        break
+                    cur = ctx.parents.get(cur)
+            if not follow_calls:
+                continue
+            # direct calls from a bound body propagate it
+            for root in list(out):
+                for node in walk_body(root):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                    ):
+                        for f in by_name.get(node.func.id, []):
+                            if f not in out:
+                                out.add(f)
+                                changed = True
+        return out
+
+    return close(bound)
+
+
+def _collective_axis_arg(node: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _in_host_loop(ctx: ModuleContext, node: ast.AST,
+                  stop: ast.AST) -> bool:
+    cur = ctx.parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def check(ctx: ModuleContext) -> list:
+    findings: list = []
+    axis_bound = _functions_passed_to(
+        ctx, _AXIS_BINDERS, follow_calls=True
+    )
+    scan_bodies = _functions_passed_to(ctx, _SCAN_CALLS)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        qual = ctx.qualname_of(node)
+
+        # ---------------------------------------------- pspec-mismatch
+        if resolved in _PSPEC_CALLS or (
+            resolved is not None and resolved.endswith(".PartitionSpec")
+        ):
+            seen: set = set()
+            for name, lit in _axis_literals_of_spec(node):
+                if name not in CANONICAL_AXES:
+                    findings.append(Finding(
+                        PSPEC_RULE_ID, ctx.path, lit.lineno,
+                        lit.col_offset, qual,
+                        f"PartitionSpec names axis {name!r} — not one of "
+                        f"the mesh axes {sorted(CANONICAL_AXES)}; on a "
+                        f"real mesh this dim silently replicates",
+                    ))
+                elif name in seen:
+                    findings.append(Finding(
+                        PSPEC_RULE_ID, ctx.path, lit.lineno,
+                        lit.col_offset, qual,
+                        f"PartitionSpec names axis {name!r} for two "
+                        f"different dims — XLA rejects a mesh axis used "
+                        f"twice in one spec",
+                    ))
+                seen.add(name)
+            continue
+
+        # ----------------------------------- collectives (two rules)
+        if resolved in _COLLECTIVE_CALLS:
+            short = resolved.rsplit(".", 1)[-1]
+            axis_arg = _collective_axis_arg(
+                node, _COLLECTIVE_CALLS[resolved]
+            )
+            func = ctx.enclosing_function(node)
+
+            # shardmap-axis-misuse: unknown axis literal
+            unknown = False
+            if axis_arg is not None:
+                for name, lit in _axis_literals(axis_arg):
+                    if name not in CANONICAL_AXES:
+                        unknown = True
+                        findings.append(Finding(
+                            AXIS_RULE_ID, ctx.path, lit.lineno,
+                            lit.col_offset, qual,
+                            f"`{short}` over axis {name!r} — not one of "
+                            f"the mesh axes {sorted(CANONICAL_AXES)}; "
+                            f"nothing binds it at trace time",
+                        ))
+            # shardmap-axis-misuse: traced but not axis-bound
+            if (
+                not unknown
+                and func is not None
+                and ctx.is_traced(func)
+                and func not in axis_bound
+            ):
+                findings.append(Finding(
+                    AXIS_RULE_ID, ctx.path, node.lineno,
+                    node.col_offset, qual,
+                    f"`{short}` inside a traced function with no "
+                    f"enclosing shard_map/pmap binding its axis — plain "
+                    f"jit has no axis environment; the first multi-chip "
+                    f"trace fails",
+                ))
+
+            # collective-in-loop: scan bodies and host loops
+            if short != "axis_index":
+                if func is not None and func in scan_bodies:
+                    findings.append(Finding(
+                        LOOP_RULE_ID, ctx.path, node.lineno,
+                        node.col_offset, qual,
+                        f"`{short}` inside a scan/loop body runs once "
+                        f"PER ITERATION — reduce locally and issue one "
+                        f"batched collective after the loop",
+                    ))
+                elif _in_host_loop(ctx, node, func):
+                    findings.append(Finding(
+                        LOOP_RULE_ID, ctx.path, node.lineno,
+                        node.col_offset, qual,
+                        f"`{short}` inside a host loop — one collective "
+                        f"dispatch per iteration; batch it",
+                    ))
+            continue
+
+        # ------------------------------------------ implicit-replication
+        if resolved in _CREATOR_CALLS and node.args:
+            func = ctx.enclosing_function(node)
+            if func is None or not ctx.is_traced(func):
+                continue
+            elements = _literal_elements(node.args[0])
+            if elements is not None and elements >= _MIN_ELEMENTS:
+                findings.append(Finding(
+                    REPL_RULE_ID, ctx.path, node.lineno,
+                    node.col_offset, qual,
+                    f"`{resolved.rsplit('.', 1)[-1]}` of {elements} "
+                    f"elements inside a traced function lands fully "
+                    f"REPLICATED on a sharded mesh — create it outside "
+                    f"jit and device_put with a NamedSharding, or add a "
+                    f"sharding constraint",
+                ))
+    return findings
+
+
+def _axis_literals_of_spec(call: ast.Call) -> list:
+    """Axis-name literals across ALL args of a PartitionSpec call (each
+    arg is an axis name, a tuple of names, or None)."""
+    out = []
+    for arg in call.args:
+        out.extend(_axis_literals(arg))
+    return out

@@ -1,0 +1,40 @@
+"""SPMD analysis: static sharding lint + compiled-HLO collective audits.
+
+Two layers, mirroring the rest of ``analysis/``:
+
+- **Static** — the spmd lint rules (``analysis/rules/spmd.py``:
+  ``pspec-mismatch``, ``shardmap-axis-misuse``, ``collective-in-loop``,
+  ``implicit-replication``) catch sharding mistakes visible in source,
+  driven by ``scripts/lint.py`` like every other rule.
+- **Runtime** — ``comm_audit`` checks a warmed program's compiled HLO
+  against its :class:`CommManifest` (expected collective kinds + byte
+  bounds), wired through ``GuardSet.wrap_jit``/``aot_warm_start`` into
+  the Trainer and serve warm paths; ``scripts/audit_hlo.py`` is the
+  standalone CLI over the same extractor.
+"""
+
+from pytorch_distributed_training_tpu.analysis.spmd.hlo import (
+    COLLECTIVE_KINDS,
+    Collective,
+    CostModel,
+    extract_collectives,
+    summarize_collectives,
+)
+from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
+    CommManifest,
+    comm_audit,
+    serve_manifest,
+    train_manifest,
+)
+
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "Collective",
+    "CommManifest",
+    "CostModel",
+    "comm_audit",
+    "extract_collectives",
+    "serve_manifest",
+    "summarize_collectives",
+    "train_manifest",
+]

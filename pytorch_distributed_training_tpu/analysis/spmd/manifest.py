@@ -1,0 +1,198 @@
+"""Expected-collective manifests + the ``comm_audit`` runtime guard.
+
+A :class:`CommManifest` is a program's pinned communication contract:
+which collective kinds it is allowed to contain, which it MUST contain,
+and (optionally) a payload-bytes ceiling. ``comm_audit`` checks a warmed
+program's compiled HLO against its manifest the same way
+``analysis/guards.donation_audit`` checks donation: parse ``as_text()``,
+emit one ``comm_audit`` telemetry record, count deviations, and raise
+:class:`~pytorch_distributed_training_tpu.analysis.guards.GuardViolation`
+in strict mode. Record mode logs deviations without failing — the
+rollout path new manifests go through before being pinned strict.
+
+Canonical manifests live here too: ``train_manifest(mesh)`` derives the
+kinds a train step may legitimately emit from which mesh axes are
+non-trivial (an fsdp mesh earns all-gather/reduce-scatter; a pipeline
+mesh earns collective-permute; a 1-device mesh earns NOTHING), and
+``serve_manifest(num_devices)`` pins today's single-device serve
+programs to zero collectives — the contract the sharded-replica work
+will consciously relax, kind by kind, instead of silently breaking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pytorch_distributed_training_tpu.analysis.spmd.hlo import (
+    COLLECTIVE_KINDS,
+    CostModel,
+    extract_collectives,
+    summarize_collectives,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CommManifest:
+    """One program's expected-collective contract.
+
+    ``allowed`` — kinds the program may contain (empty = zero
+    collectives); ``required`` — kinds that must appear (catches the
+    opposite regression: a "sharded" program that stopped communicating
+    because everything got replicated); ``max_bytes`` — ceiling on total
+    payload bytes across all collectives (e.g. a small multiple of param
+    bytes for an fsdp step).
+    """
+
+    name: str
+    allowed: tuple = ()
+    required: tuple = ()
+    max_bytes: Optional[int] = None
+
+    def __post_init__(self):
+        for kind in tuple(self.allowed) + tuple(self.required):
+            if kind not in COLLECTIVE_KINDS:
+                raise ValueError(
+                    f"manifest {self.name!r}: unknown collective kind "
+                    f"{kind!r} (must be one of {COLLECTIVE_KINDS})"
+                )
+
+    def check(self, summary: dict) -> list:
+        """Deviations of an extracted-collective summary from this
+        manifest (empty list = conforming)."""
+        deviations = []
+        kinds = set(summary.get("by_kind", {}))
+        allowed = set(self.allowed) | set(self.required)
+        for kind in sorted(kinds - allowed):
+            slot = summary["by_kind"][kind]
+            deviations.append(
+                f"unexpected {kind} x{slot['count']} "
+                f"({slot['bytes']} payload bytes)"
+            )
+        for kind in self.required:
+            if kind not in kinds:
+                deviations.append(f"required {kind} absent")
+        if (
+            self.max_bytes is not None
+            and summary.get("total_bytes", 0) > self.max_bytes
+        ):
+            deviations.append(
+                f"total payload {summary['total_bytes']}B exceeds "
+                f"manifest ceiling {self.max_bytes}B"
+            )
+        return deviations
+
+    def to_record(self) -> dict:
+        return {
+            "name": self.name,
+            "allowed": list(self.allowed),
+            "required": list(self.required),
+            "max_bytes": self.max_bytes,
+        }
+
+
+def train_manifest(mesh, *, max_bytes: Optional[int] = None,
+                   name: str = "train_step",
+                   fsdp_sharded: bool = False) -> CommManifest:
+    """The kinds a train step may emit on this mesh. 1-device meshes pin
+    zero collectives; a data axis earns gradient all-reduce; fsdp/model
+    axes earn param all-gather + grad reduce-scatter (and all-to-all for
+    tensor-parallel layouts); a stage axis earns pipeline permutes.
+
+    ``fsdp_sharded=True`` (the mesh has an fsdp axis AND the sharding
+    policy actually shards params over it) additionally REQUIRES an
+    all-gather: sharded params must be gathered somewhere, so a step
+    with none means everything silently ended up replicated — the
+    de-sharding regression this manifest exists to catch."""
+    shape = dict(mesh.shape)
+    if max(shape.values(), default=1) <= 1:
+        return CommManifest(name, allowed=(), max_bytes=max_bytes)
+    allowed = ["all-reduce"]
+    required = []
+    if shape.get("fsdp", 1) > 1 or shape.get("model", 1) > 1:
+        allowed += ["all-gather", "reduce-scatter"]
+        if fsdp_sharded and shape.get("fsdp", 1) > 1:
+            required += ["all-gather"]
+    if shape.get("model", 1) > 1:
+        allowed += ["all-to-all"]
+    if shape.get("stage", 1) > 1:
+        allowed += ["collective-permute"]
+    return CommManifest(
+        name, allowed=tuple(allowed), required=tuple(required),
+        max_bytes=max_bytes,
+    )
+
+
+def serve_manifest(num_devices: int = 1,
+                   name: str = "serve") -> CommManifest:
+    """Serve programs on one device move nothing between chips — pinned.
+    Multi-device serving (the sharded-replica roadmap item) starts from
+    the full allowance and narrows per program as manifests get pinned."""
+    if num_devices <= 1:
+        return CommManifest(name, allowed=())
+    return CommManifest(name, allowed=COLLECTIVE_KINDS)
+
+
+def comm_audit(
+    name: str,
+    stage,
+    manifest: CommManifest,
+    *,
+    registry=None,
+    mode: str = "record",
+    cost_model: Optional[CostModel] = None,
+    world_size: Optional[int] = None,
+) -> dict:
+    """Audit a warmed program's collective footprint against ``manifest``.
+
+    ``stage`` is a ``Lowered`` or ``Compiled`` (anything with
+    ``as_text()``) — pass the COMPILED object: SPMD-partitioner
+    collectives only exist post-compile. Emits one ``comm_audit``
+    record; deviations bump ``guards/comm_deviations`` and raise
+    ``GuardViolation`` in strict mode.
+    """
+    from pytorch_distributed_training_tpu.analysis.guards import (
+        GuardViolation,
+        _registry_or_default,
+    )
+
+    registry = _registry_or_default(registry)
+    try:
+        text = stage.as_text()
+    except Exception as e:  # pragma: no cover - backend without text dump
+        record = {
+            "record": "comm_audit", "name": name,
+            "manifest": manifest.name, "ok": None,
+            "error": str(e)[:200],
+        }
+        registry.emit(record)
+        return record
+    if world_size is None:
+        try:
+            import jax
+
+            world_size = jax.device_count()
+        except Exception:  # pragma: no cover - jax-free caller
+            world_size = None
+    summary = summarize_collectives(
+        extract_collectives(text, world_size=world_size),
+        cost_model=cost_model,
+    )
+    deviations = manifest.check(summary)
+    record = {
+        "record": "comm_audit",
+        "name": name,
+        "manifest": manifest.name,
+        "ok": not deviations,
+        "deviations": deviations,
+        **summary,
+    }
+    registry.emit(record)
+    if deviations:
+        registry.inc("guards/comm_deviations", len(deviations))
+        if mode == "strict":
+            raise GuardViolation(
+                f"comm audit {name!r}: compiled program deviates from "
+                f"manifest {manifest.name!r}: {'; '.join(deviations)}"
+            )
+    return record

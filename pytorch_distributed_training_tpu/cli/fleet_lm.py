@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sampling", default="device",
                    choices=("device", "host"),
                    help="replica sampling mode (see serve_lm)")
+    p.add_argument("--guards", default=None,
+                   choices=("off", "record", "strict"),
+                   help="runtime guard + lock-discipline mode, forwarded "
+                        "to every replica and applied to the coordinator's "
+                        "own locks: strict (default) fails on violations; "
+                        "--guards record is the telemetry-only opt-out; "
+                        "PDT_TPU_GUARDS overrides the default")
     p.add_argument("--lock-summary-s", type=float, default=0.0,
                    help="emit an in-run lock_summary record every this many "
                         "seconds from the coordinator AND every replica "
@@ -111,6 +118,20 @@ def main(argv=None) -> dict:
     )
     from pytorch_distributed_training_tpu.utils.logging import log0
 
+    from pytorch_distributed_training_tpu.analysis.concurrency import (
+        get_lock_registry,
+    )
+    from pytorch_distributed_training_tpu.analysis.guards import (
+        guard_mode_from_env,
+    )
+
+    # same strict-by-default contract as serve_lm (PR 11): the
+    # coordinator's router/breaker/watcher locks run under the chosen
+    # discipline, and the resolved mode is forwarded to every replica so
+    # the whole fleet agrees
+    guard_mode = args.guards or guard_mode_from_env(default="strict")
+    get_lock_registry().mode = guard_mode
+
     registry = get_registry()
     sink = None
     if args.metrics_dir:
@@ -138,6 +159,7 @@ def main(argv=None) -> dict:
         "--page-size", str(args.page_size),
         "--num-pages", str(args.num_pages),
         "--sampling", args.sampling,
+        "--guards", guard_mode,
     ]
     if args.lock_summary_s > 0:
         replica_args += ["--lock-summary-s", str(args.lock_summary_s)]

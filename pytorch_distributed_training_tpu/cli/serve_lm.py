@@ -116,10 +116,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream serve telemetry (JSONL) under this directory")
     p.add_argument("--guards", default=None,
                    choices=("off", "record", "strict"),
-                   help="runtime correctness guards (analysis/guards.py): "
-                        "record (default) emits recompile/implicit-transfer "
-                        "telemetry; strict also fails the serve loop; "
-                        "default comes from PDT_TPU_GUARDS")
+                   help="runtime correctness guards (analysis/guards.py) "
+                        "AND lock-discipline mode (analysis/concurrency): "
+                        "strict (default) fails the serve loop on "
+                        "recompile/implicit-transfer/lock-order "
+                        "violations; pass --guards record to only emit "
+                        "telemetry (the rollout opt-out), off to disable; "
+                        "PDT_TPU_GUARDS overrides the default")
     return p
 
 
@@ -178,19 +181,27 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         sampling=args.sampling,
         warmup=args.warmup,
     )
+    from pytorch_distributed_training_tpu.analysis.concurrency import (
+        get_lock_registry,
+    )
     from pytorch_distributed_training_tpu.analysis.guards import (
         GuardSet,
         guard_mode_from_env,
     )
+
+    # the serve CLI runs strict by default (PR 11): violations fail the
+    # loop instead of just logging; --guards record is the opt-out. Lock
+    # discipline follows the same mode — set before any server/engine
+    # lock is created so off-mode skips instrumentation entirely.
+    guard_mode = args.guards or guard_mode_from_env(default="strict")
+    get_lock_registry().mode = guard_mode
 
     server = InferenceServer(
         model, params, config,
         queue_depth=args.queue_depth,
         default_deadline_s=args.deadline_s or None,
         registry=registry,
-        guards=GuardSet(
-            mode=args.guards or guard_mode_from_env(), registry=registry
-        ),
+        guards=GuardSet(mode=guard_mode, registry=registry),
         stall_timeout_s=args.stall_timeout_s,
         weights_step=boot_step,
     ).start()

@@ -82,6 +82,9 @@ from pytorch_distributed_training_tpu.analysis.guards import (
     GuardSet,
     guard_mode_from_env,
 )
+from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
+    serve_manifest,
+)
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
 from pytorch_distributed_training_tpu.serve.paged_cache import (
     PageAllocator,
@@ -365,6 +368,18 @@ class DecodeEngine:
 
     # -------------------------------------------------------------- compiled
 
+    def _serve_manifest(self, name: str):
+        """Expected-collective manifest for one serve program: today's
+        engine is single-device by construction (no mesh), so the pinned
+        contract is ZERO collectives. The audit costs one extra compile
+        per program, so only the DECODE program of a warmed engine is
+        audited — it's the steady-state hot loop, and the per-bucket
+        prefills share its partitioning story (and already carry
+        donation audits). Tests that skip warmup skip the manifest too."""
+        if not self.config.warmup or name != "serve_decode":
+            return None
+        return serve_manifest(1, name=name)
+
     def _prefill_fn(self, bucket: int):
         """Jitted prefill-into-slot for one prompt bucket. Compiles once per
         bucket (the queue only produces configured buckets).
@@ -459,6 +474,7 @@ class DecodeEngine:
             f"serve_prefill_b{bucket}",
             jax.jit(prefill, donate_argnums=(1,)),
             audit_donation=True,
+            comm_manifest=self._serve_manifest(f"serve_prefill_b{bucket}"),
         )
         self._prefill_fns[bucket] = fn
         return fn
@@ -538,6 +554,7 @@ class DecodeEngine:
             "serve_decode",
             jax.jit(decode, donate_argnums=(1,)),
             audit_donation=True,
+            comm_manifest=self._serve_manifest("serve_decode"),
         )
         return self._decode_fn
 

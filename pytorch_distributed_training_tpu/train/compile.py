@@ -81,6 +81,7 @@ def aot_warm_start(
     cache_dir: str | None = None,
     registry=None,
     guard_mode: str = "off",
+    comm_manifest=None,
 ):
     """AOT-compile the steps against abstract batches; returns
     ``(compiled_train, compiled_eval, record)``.
@@ -96,6 +97,12 @@ def aot_warm_start(
     donation — optimizer state would sit double-resident in HBM. The
     audit emits a ``donation_audit`` record through ``registry`` (strict:
     raises).
+
+    With a ``comm_manifest`` (``analysis/spmd/manifest.CommManifest``,
+    typically ``train_manifest(mesh)``) the compiled train step's
+    collective footprint is also audited — the compiled object is already
+    in hand here, so the comm audit costs one ``as_text()`` parse, not an
+    extra compile.
     """
     entries_before = cache_entry_count(cache_dir)
     t0 = time.perf_counter()
@@ -112,6 +119,15 @@ def aot_warm_start(
             "train_step", compiled_train,
             registry=registry, mode=guard_mode,
         )
+        if comm_manifest is not None:
+            from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
+                comm_audit,
+            )
+
+            comm_audit(
+                "train_step", compiled_train, comm_manifest,
+                registry=registry, mode=guard_mode,
+            )
     t0 = time.perf_counter()
     compiled_eval = eval_step.lower(
         state, _attach_shardings(eval_spec, mesh, eval_pspec)

@@ -558,10 +558,25 @@ class Trainer:
             BATCH_AXES,
             TRAIN_BATCH_PSPEC,
         )
+        from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
+            train_manifest,
+        )
         from pytorch_distributed_training_tpu.train.compile import (
             aot_warm_start,
         )
 
+        # the manifest REQUIRES an all-gather only when some param is
+        # actually laid out over the fsdp axis — a policy that's on but
+        # never applied (all leaves under fsdp_min_size) legally gathers
+        # nothing
+        fsdp_sharded = any(
+            any(
+                "fsdp" in (ax if isinstance(ax, tuple) else (ax,))
+                for ax in s.spec
+                if ax is not None
+            )
+            for s in jax.tree.leaves(self.shardings)
+        )
         try:
             compiled_train, compiled_eval, record = aot_warm_start(
                 train_step=self.train_step,
@@ -575,6 +590,9 @@ class Trainer:
                 cache_dir=self.compile_cache_dir,
                 registry=self.registry,
                 guard_mode=self.guards.mode,
+                comm_manifest=train_manifest(
+                    self.mesh, fsdp_sharded=fsdp_sharded
+                ),
             )
         except GuardViolation:
             # a strict donation-audit failure is a finding, not a compile

@@ -1,0 +1,153 @@
+"""Audit the collective footprint (and fusions) of a compiled train step.
+
+Subsumes the old ``dump_hlo.py``: compiles the production train step (or
+reads an existing HLO dump with ``--hlo-file``), writes the full text to
+``--out``, and reports every collective the SPMD partitioner inserted —
+kind, payload/moved bytes, group sizes, ICI vs DCN split — through
+``analysis/spmd/hlo.py``'s extractor and cost model.
+
+Usage:
+  python scripts/audit_hlo.py [micro] [--model NAME] [--seq N]
+      [--global-batch N]      # compile the production step (trace_step)
+  python scripts/audit_hlo.py --hlo-file /tmp/step_hlo.txt
+      [--world-size N]        # audit an existing dump, jax-free
+  --json                      # machine-readable summary on stdout
+  --check                     # exit 1 unless the footprint conforms to
+                              # the mesh-derived train manifest
+  --expect KINDS              # comma-separated allowed kinds overriding
+                              # the mesh-derived manifest (e.g.
+                              # --expect all-gather,reduce-scatter)
+  --max-bytes N               # payload-bytes ceiling for --check
+  --fusions                   # also print one representative instruction
+                              # per named-fusion family (dump_hlo's job)
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_training_tpu.analysis.spmd.hlo import (  # noqa: E402
+    COLLECTIVE_KINDS,
+    extract_collectives,
+    summarize_collectives,
+)
+from pytorch_distributed_training_tpu.analysis.spmd.manifest import (  # noqa: E402
+    CommManifest,
+    train_manifest,
+)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("micro", nargs="?", type=int, default=32)
+    p.add_argument("--model", default="bert-large-cased")
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--global-batch", type=int, default=None)
+    p.add_argument("--hlo-file", default=None,
+                   help="audit this HLO text instead of compiling")
+    p.add_argument("--out", default="/tmp/step_hlo.txt",
+                   help="where the full HLO text is written when compiling")
+    p.add_argument("--world-size", type=int, default=None,
+                   help="device count for iota replica groups "
+                        "(default: jax.device_count() when compiling)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--expect", default=None,
+                   help="comma-separated allowed collective kinds")
+    p.add_argument("--max-bytes", type=int, default=None)
+    p.add_argument("--fusions", action="store_true")
+    return p.parse_args(argv)
+
+
+def _fusion_families(txt):
+    """One representative instruction per named-fusion family."""
+    fams = {}
+    for m in re.finditer(
+        r"^\s*%?((?:[a-z_]+)fusion)\.(\d+)\s.*?(?=^\s*%|\Z)",
+        txt,
+        re.M | re.S,
+    ):
+        fams.setdefault(m.group(1), m.group(0)[:1500])
+    return fams
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    manifest = None
+    if args.hlo_file:
+        with open(args.hlo_file) as f:
+            txt = f.read()
+        world_size = args.world_size
+    else:
+        from trace_step import build_step  # noqa: E402  (same dir)
+
+        import jax
+
+        step, state, batch = build_step(
+            args.micro, model_name=args.model,
+            seq=args.seq, global_batch=args.global_batch,
+        )
+        txt = step.lower(state, batch).compile().as_text()
+        with open(args.out, "w") as f:
+            f.write(txt)
+        print(f"HLO written: {args.out} ({len(txt)} bytes)", file=sys.stderr)
+        world_size = args.world_size or jax.device_count()
+        from pytorch_distributed_training_tpu.comms.mesh import build_mesh
+
+        manifest = train_manifest(build_mesh(), max_bytes=args.max_bytes)
+    if args.expect is not None:
+        allowed = tuple(k for k in args.expect.split(",") if k)
+        for k in allowed:
+            if k not in COLLECTIVE_KINDS:
+                raise SystemExit(
+                    f"--expect: unknown kind {k!r} "
+                    f"(must be among {COLLECTIVE_KINDS})"
+                )
+        manifest = CommManifest(
+            "cli-expect", allowed=allowed, max_bytes=args.max_bytes
+        )
+
+    collectives = extract_collectives(txt, world_size=world_size)
+    summary = summarize_collectives(collectives)
+    deviations = manifest.check(summary) if manifest is not None else []
+
+    if args.json:
+        print(json.dumps({
+            "summary": summary,
+            "manifest": manifest.to_record() if manifest else None,
+            "deviations": deviations,
+            "collectives": [
+                {"name": c.name, "kind": c.kind, "dtype": c.dtype,
+                 "bytes": c.bytes, "group_size": c.group_size,
+                 "line": c.line, "asynchronous": c.asynchronous}
+                for c in collectives
+            ],
+        }, indent=2))
+    else:
+        print(f"collectives: {summary['count']} "
+              f"({summary['total_bytes']} payload B, "
+              f"{summary['total_moved_bytes']} moved B, "
+              f"~{summary['est_time_s'] * 1e3:.3f} ms)")
+        for kind, slot in sorted(summary["by_kind"].items()):
+            print(f"  {kind:20s} x{slot['count']:<4d} "
+                  f"{slot['bytes']:>12d} B payload  "
+                  f"{slot['moved_bytes']:>12d} B moved")
+        if manifest is not None:
+            verdict = "CONFORMS" if not deviations else "DEVIATES"
+            print(f"manifest {manifest.name!r}: {verdict}")
+            for d in deviations:
+                print(f"  - {d}")
+    if args.fusions:
+        for fam, body in _fusion_families(txt).items():
+            print(f"\n===== {fam} =====\n{body}\n")
+    if args.check and deviations:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,10 @@ def main(argv=None) -> int:
     p.add_argument("--check", action="store_true",
                    help="exit 1 when any unwaived finding (or parse error) "
                         "remains")
+    p.add_argument("--rules", default=None, metavar="ID,ID,...",
+                   help="comma-separated rule ids to run (e.g. "
+                        "pspec-mismatch,collective-in-loop); other rules' "
+                        "waivers are never reported unused")
     p.add_argument("--json", action="store_true",
                    help="print the full report as JSON")
     p.add_argument("--waivers", default=DEFAULT_WAIVERS,
@@ -99,7 +103,15 @@ def main(argv=None) -> int:
             return 0
     else:
         paths = args.paths or DEFAULT_PATHS
-    report = lint_paths(paths, waivers)
+    rule_ids = None
+    if args.rules is not None:
+        rule_ids = tuple(r for r in args.rules.split(",") if r)
+        if not rule_ids:
+            p.error("--rules needs at least one rule id")
+    try:
+        report = lint_paths(paths, waivers, rule_ids=rule_ids)
+    except ValueError as e:    # unknown rule id
+        p.error(str(e))
     if args.changed is not None:
         # a subset run can't see every waiver's file — unused here != dead
         report.unused_waivers = []

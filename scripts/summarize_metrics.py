@@ -117,6 +117,7 @@ def summarize(records: list[dict]) -> dict:
         "swap": summarize_swap(records),
         "guards": guards,
         "locks": summarize_locks(records),
+        "comm": summarize_comm(records),
     }
 
 
@@ -152,6 +153,42 @@ def summarize_guards(records: list[dict]) -> dict | None:
             "clean": last.get("clean"),
         }
     return out
+
+
+def summarize_comm(records: list[dict]) -> dict | None:
+    """Fold ``comm_audit`` records (analysis/spmd/manifest.py) into the
+    collective-footprint view: one row per audited program (last audit
+    per program wins — audits re-run on hot-swap/recompile) with
+    collective counts by kind, payload/moved bytes and manifest verdict.
+    None when the stream holds no comm records."""
+    audits = [r for r in records if r.get("record") == "comm_audit"]
+    if not audits:
+        return None
+    by_name: dict[str, dict] = {}
+    for r in audits:
+        by_name[r.get("name") or "?"] = r
+    programs = {}
+    for name in sorted(by_name):
+        r = by_name[name]
+        programs[name] = {
+            "manifest": r.get("manifest"),
+            "ok": r.get("ok"),
+            "collectives": r.get("count"),
+            "by_kind": {
+                k: v.get("count") for k, v in (r.get("by_kind") or {}).items()
+            },
+            "total_bytes": r.get("total_bytes"),
+            "total_moved_bytes": r.get("total_moved_bytes"),
+            "est_time_s": r.get("est_time_s"),
+            "deviations": r.get("deviations") or [],
+            "error": r.get("error"),
+        }
+    return {
+        "audits": len(audits),
+        "programs": programs,
+        "deviations": sum(len(p["deviations"]) for p in programs.values()),
+        "clean": all(p["ok"] is not False for p in programs.values()),
+    }
 
 
 def summarize_locks(records: list[dict]) -> dict | None:
@@ -549,6 +586,44 @@ def render_locks_table(locks: dict, top_n: int = 8) -> str:
     return "\n".join(lines)
 
 
+def render_comm_table(comm: dict) -> str:
+    """Per-program collective-footprint rows + a manifest verdict footer."""
+    cols = ["program", "collectives", "kinds", "payload B", "moved B",
+            "manifest", "verdict"]
+    rows = []
+    for name, p in comm["programs"].items():
+        kinds = ",".join(
+            f"{k}x{n}" for k, n in sorted(p["by_kind"].items())
+        ) or "-"
+        verdict = (
+            "ERROR" if p["error"] else
+            "ok" if p["ok"] else
+            "?" if p["ok"] is None else "DEVIATES"
+        )
+        rows.append([
+            name, _fmt(p["collectives"]), kinds, _fmt(p["total_bytes"]),
+            _fmt(p["total_moved_bytes"]), p["manifest"] or "-", verdict,
+        ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "comm:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    lines.append(
+        f"audits={comm['audits']} deviations={comm['deviations']}"
+        + (" [clean]" if comm["clean"] else " [VIOLATIONS]")
+    )
+    for name, p in comm["programs"].items():
+        for d in p["deviations"]:
+            lines.append(f"  DEVIATION {name}: {d}")
+    return "\n".join(lines)
+
+
 def render_table(summary: dict) -> str:
     cols = [
         ("epoch", "epoch"),
@@ -615,6 +690,9 @@ def render_table(summary: dict) -> str:
     locks = summary.get("locks")
     if locks:
         lines.append(render_locks_table(locks))
+    comm = summary.get("comm")
+    if comm:
+        lines.append(render_comm_table(comm))
     guards = summary.get("guards")
     if guards:
         bad = (

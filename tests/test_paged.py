@@ -565,6 +565,11 @@ def test_strict_tick_scope_two_buckets_zero_implicit_transfers(lm):
     assert not sink.of("recompile") and not sink.of("implicit_transfer")
     for name in ("serve_prefill_b4", "serve_prefill_b8", "serve_decode"):
         assert gs.wrapped[name].calls >= 2, name
+    # the warmed decode program passed its strict collective manifest:
+    # a single-device engine moves zero bytes between chips
+    (comm,) = sink.of("comm_audit")
+    assert comm["name"] == "serve_decode" and comm["ok"] is True
+    assert comm["count"] == 0
 
 
 # ------------------------------------------------- periodic lock summaries

@@ -198,6 +198,209 @@ def test_stage_sharded_scan_forward(eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+# ------------------------------------------------- collective footprints
+#
+# The communication contract of the existing parallel train steps, pinned
+# as exact collective-kind sets + byte bounds (analysis/spmd). These are
+# the regression tripwires for the sharded-replica work: an unexpected
+# kind (or a param-bytes blowup) here means XLA's sharding propagation
+# changed the program's comm pattern.
+
+
+def _fsdp_step_and_state(mesh):
+    """A compiled-ready fsdp train step + sharded state + global batch on
+    the 8-device CPU mesh (micro divisible by data*fsdp=8)."""
+    from pytorch_distributed_training_tpu.comms.ingest import (
+        make_global_batch,
+    )
+    from pytorch_distributed_training_tpu.comms.mesh import TRAIN_BATCH_PSPEC
+    from pytorch_distributed_training_tpu.parallel.sharding import shard_state
+    from pytorch_distributed_training_tpu.train.optim import (
+        adamw_with_schedule,
+    )
+    from pytorch_distributed_training_tpu.train.state import (
+        create_train_state,
+    )
+    from pytorch_distributed_training_tpu.train.step import make_train_step
+    from pytorch_distributed_training_tpu.utils.config import TrainConfig
+
+    cfg = tiny()
+    model = BertForSequenceClassification(cfg)
+    tcfg = TrainConfig(
+        global_batch_size=16, micro_batch_size=8, max_seq_length=16,
+    )
+    tx, _ = adamw_with_schedule(tcfg, total_steps=10)
+    seq = 16
+    ex = example(batch=2, seq=seq, vocab=cfg.vocab_size)
+    state = create_train_state(
+        model, tx, jax.random.key(0, impl="rbg"), ex
+    )
+    shardings = state_shardings(
+        state, ShardingPolicy(fsdp=True, fsdp_min_size=128), mesh
+    )
+    state = shard_state(state, shardings)
+    step = make_train_step(
+        grad_accum_steps=tcfg.grad_accum_steps, mesh=mesh,
+        state_shardings=shardings, objective="classification",
+    )
+    rng = np.random.default_rng(0)
+    accum, micro = tcfg.grad_accum_steps, tcfg.micro_batch_size
+    b = {
+        "input_ids": rng.integers(
+            5, cfg.vocab_size, (accum, micro, seq)
+        ).astype(np.int32),
+        "attention_mask": np.ones((accum, micro, seq), np.int32),
+        "token_type_ids": np.zeros((accum, micro, seq), np.int32),
+        "labels": rng.integers(0, 2, (accum, micro)).astype(np.int32),
+    }
+    batch = make_global_batch(mesh, b, pspec=TRAIN_BATCH_PSPEC)
+    return step, state, batch, accum
+
+
+@pytest.fixture(scope="module")
+def fsdp_compiled(eight_devices):
+    """The sharded fsdp step compiled ONCE for the footprint tests (the
+    compile dominates their cost; both the positive pin and the negative
+    de-sharding test audit the same program)."""
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+    step, state, batch, accum = _fsdp_step_and_state(mesh)
+    compiled = step.lower(state, batch).compile()
+    return mesh, state, batch, accum, compiled
+
+
+def test_fsdp_train_step_collective_footprint(fsdp_compiled):
+    """The fsdp step's compiled comm contract: parameter all-gathers plus
+    gradient/metric all-reduces (XLA:CPU folds the grad reduce-scatter
+    into all-reduce), nothing else, and the gather payload stays within a
+    small multiple of param bytes per accumulation step."""
+    from pytorch_distributed_training_tpu.analysis.spmd import (
+        extract_collectives,
+        summarize_collectives,
+        train_manifest,
+    )
+
+    mesh, state, batch, accum, compiled = fsdp_compiled
+    summary = summarize_collectives(
+        extract_collectives(compiled.as_text(), world_size=8)
+    )
+    kinds = set(summary["by_kind"])
+    assert "all-gather" in kinds          # sharded params get gathered
+    assert kinds <= {"all-gather", "all-reduce", "reduce-scatter"}
+    # param-bytes bound: each accumulation step may gather every sharded
+    # param once for fwd and once for bwd (plus optimizer-update gathers)
+    param_bytes = sum(
+        leaf.nbytes for leaf in jax.tree.leaves(state.params)
+    )
+    ag_bytes = summary["by_kind"]["all-gather"]["bytes"]
+    assert ag_bytes <= 4 * accum * param_bytes, (
+        f"all-gather payload {ag_bytes}B exceeds "
+        f"{4 * accum} x param bytes ({param_bytes}B) — params are being "
+        f"re-gathered more than the fsdp schedule allows"
+    )
+    # and the derived manifest agrees (required all-gather included)
+    manifest = train_manifest(mesh, fsdp_sharded=True)
+    assert manifest.check(summary) == []
+
+
+def test_pipeline_train_step_collective_footprint(eight_devices):
+    """The gpipe program's compiled comm contract: the per-tick stage
+    hand-off permutes plus the data-axis reduce, and nothing else — an
+    all-gather here would mean activations stopped flowing point-to-point
+    and started materializing everywhere."""
+    import dataclasses
+
+    from pytorch_distributed_training_tpu.analysis.spmd import (
+        extract_collectives,
+        summarize_collectives,
+        train_manifest,
+    )
+    from pytorch_distributed_training_tpu.ops.attention import (
+        make_attention_bias,
+    )
+    from pytorch_distributed_training_tpu.parallel.pipeline import (
+        gpipe_apply,
+        gpipe_trunk_fn,
+    )
+
+    cfg = tiny(num_layers=4, hidden_dropout=0.0, attention_dropout=0.0)
+    scfg = dataclasses.replace(cfg, scan_layers=True)
+    model = BertForSequenceClassification(scfg)
+    ids = jnp.ones((4, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    stacked = params["bert"]["layers_scan"]["layer"]
+    rng = np.random.default_rng(0)
+    n_micro, mb, seq, h = 4, 2, 16, cfg.hidden_size
+    xs = jnp.asarray(rng.normal(size=(n_micro, mb, seq, h)), jnp.float32)
+    mask = jnp.asarray(
+        rng.integers(0, 2, (n_micro, mb, seq)), jnp.int32
+    ).at[:, :, 0].set(1)
+    biases = jax.vmap(make_attention_bias)(mask)
+
+    mesh = build_mesh(MeshConfig(data=4, stage=2))
+    layer_fn = gpipe_trunk_fn(cfg)
+    f = jax.jit(lambda p, x, b: gpipe_apply(mesh, layer_fn, p, x, b))
+    txt = f.lower(stacked, xs, biases).compile().as_text()
+    summary = summarize_collectives(
+        extract_collectives(txt, world_size=8)
+    )
+    kinds = set(summary["by_kind"])
+    assert "collective-permute" in kinds  # the stage hand-off IS permutes
+    assert kinds <= {"collective-permute", "all-reduce"}
+    assert train_manifest(mesh).check(summary) == []
+
+
+def test_desharded_step_caught_by_strict_comm_audit(fsdp_compiled):
+    """Acceptance negative: a replicated-policy step on the same fsdp
+    mesh emits NO all-gather — the silent de-sharding regression. The
+    strict comm_audit must raise AND leave the deviation in telemetry."""
+    from pytorch_distributed_training_tpu.analysis.guards import (
+        GuardViolation,
+    )
+    from pytorch_distributed_training_tpu.analysis.spmd import (
+        comm_audit,
+        train_manifest,
+    )
+    from pytorch_distributed_training_tpu.parallel.sharding import (
+        shard_state,
+    )
+    from pytorch_distributed_training_tpu.train.step import make_train_step
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+    from test_guards import ListSink  # sibling module (pytest sys.path)
+
+    mesh, state, batch, accum, compiled_ok = fsdp_compiled
+    # deliberately de-shard: replicate every param on the SAME mesh
+    shardings_r = state_shardings(state, ShardingPolicy(fsdp=False), mesh)
+    state_r = shard_state(jax.device_get(state), shardings_r)
+    step_r = make_train_step(
+        grad_accum_steps=accum, mesh=mesh, state_shardings=shardings_r,
+        objective="classification",
+    )
+    compiled = step_r.lower(state_r, batch).compile()
+
+    registry = MetricsRegistry()
+    sink = ListSink()
+    registry.attach_sink(sink)
+    manifest = train_manifest(mesh, fsdp_sharded=True)
+    with pytest.raises(GuardViolation, match="required all-gather absent"):
+        comm_audit(
+            "train_step", compiled, manifest,
+            registry=registry, mode="strict", world_size=8,
+        )
+    (rec,) = sink.of("comm_audit")
+    assert rec["ok"] is False
+    assert any("all-gather" in d for d in rec["deviations"])
+    counters = registry.snapshot()["counters"]
+    assert counters["guards/comm_deviations"] >= 1
+    # the sharded original conforms under the same strict manifest
+    rec_ok = comm_audit(
+        "train_step", compiled_ok, manifest,
+        registry=registry, mode="strict", world_size=8,
+    )
+    assert rec_ok["ok"] is True
+
+
 @pytest.mark.parametrize("mode", ["branch", "stage"])
 @pytest.mark.slow
 def test_mp_trainer_end_to_end(eight_devices, mode):
