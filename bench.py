@@ -672,7 +672,12 @@ def run_serve(
 
 
 def _paged_child(cfg_json: str) -> None:
-    """One engine configuration over one closed-loop workload."""
+    """One engine configuration over one closed-loop workload. Also the
+    child for --spec: optional ``spec_k``/``prefill_chunk`` cfg keys turn
+    speculation/chunked prefill on, and the result carries a digest of the
+    token streams (request-order) so the parent can assert the A/B
+    variants emitted IDENTICAL tokens."""
+    import hashlib
     import threading
 
     import jax
@@ -718,6 +723,8 @@ def _paged_child(cfg_json: str) -> None:
         max_new_tokens=max_new,
         kv_layout=cfg["kv_layout"], sampling=cfg["sampling"],
         page_size=cfg["page_size"], num_pages=cfg["num_pages"],
+        spec_k=cfg.get("spec_k", 0),
+        prefill_chunk=cfg.get("prefill_chunk", 0),
     )
     server = InferenceServer(
         model, params, ecfg,
@@ -740,6 +747,7 @@ def _paged_child(cfg_json: str) -> None:
     work = list(enumerate(prompts))
     lock = threading.Lock()
     rejected = [0]
+    streams: dict[int, list] = {}
 
     def client():
         while True:
@@ -760,6 +768,8 @@ def _paged_child(cfg_json: str) -> None:
                         rejected[0] += 1
                     time.sleep(0.002)
             _await_done(req.done, "request completion")
+            with lock:
+                streams[i] = [int(t) for t in req.tokens]
 
     threads = [
         threading.Thread(target=client, daemon=True)
@@ -788,6 +798,20 @@ def _paged_child(cfg_json: str) -> None:
         "kv_pages_total": stats.get("kv_pages_total"),
         "kv_pages_peak": stats.get("kv_pages_peak"),
         "page_exhausted": stats.get("page_exhausted"),
+        "buckets": serve_summary["buckets"],
+        # token-identity key: same digest across variants <=> bit-identical
+        # streams for every request (request order, not completion order)
+        "stream_digest": hashlib.sha256(
+            json.dumps([streams[i] for i in sorted(streams)]).encode()
+        ).hexdigest(),
+        "spec_k": stats.get("spec_k", 0),
+        "spec_dispatches": stats.get("spec_dispatches"),
+        "spec_drafted": stats.get("spec_drafted"),
+        "spec_accepted": stats.get("spec_accepted"),
+        "spec_accept_rate": stats.get("spec_accept_rate"),
+        "tokens_per_dispatch": stats.get("tokens_per_dispatch"),
+        "prefill_chunk": stats.get("prefill_chunk", 0),
+        "prefill_chunks": stats.get("prefill_chunks"),
     }
     print(json.dumps(result))
 
@@ -871,6 +895,92 @@ def run_paged(
             ),
             "paged_device": mixed,
         },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+# ---------------------------------------------------------------- spec mode
+# Speculative-decoding + chunked-prefill A/B on CPU: the same closed-loop
+# load through four paged+device engine configurations — baseline, spec
+# only, chunked prefill only, and both — all greedy so the token-identity
+# contract is checkable from the digests (every variant MUST emit the same
+# streams; speculation/chunking are latency knobs, not sampling changes).
+# Reports per-bucket TTFT/TPOT, acceptance stats, and the TPOT speedup the
+# perf gate asserts (>= 2x on the dispatch-overhead-dominated CPU bench).
+# Writes BENCH_spec.json; driven by the `perf`+`serve`-marked pytest in
+# tests/test_spec.py, kept out of tier-1 timing noise.
+
+
+def run_spec(
+    requests: int = 16,
+    concurrency: int = 6,
+    slots: int = 4,
+    max_new: int = 32,
+    spec_k: int = 7,
+    prefill_chunk: int = 8,
+    page_size: int = 8,
+    queue_depth: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+
+    # mixed prompt lengths so chunked prefill has real work (the longest
+    # prompt streams in over several chunks) and per-bucket latency rows
+    # are populated; greedy so the n-gram drafter's acceptance — and the
+    # cross-variant stream digests — are deterministic
+    prompt_mix = [8, 16, 32, 48]
+
+    def one(name: str, **over) -> dict:
+        base = dict(
+            requests=requests, concurrency=concurrency, slots=slots,
+            max_new=max_new, queue_depth=queue_depth, page_size=page_size,
+            num_pages=0, temperature=0.0, top_k=0, prompt_mix=prompt_mix,
+            kv_layout="paged", sampling="device",
+        )
+        base.update(over)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--paged-child", json.dumps(base)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"spec bench variant {name!r} failed "
+                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    baseline = one("baseline")
+    spec = one("spec", spec_k=spec_k)
+    chunked = one("chunked", prefill_chunk=prefill_chunk)
+    both = one("spec_chunked", spec_k=spec_k, prefill_chunk=prefill_chunk)
+
+    variants = {
+        "baseline": baseline, "spec": spec,
+        "chunked": chunked, "spec_chunked": both,
+    }
+    digests = {n: v["stream_digest"] for n, v in variants.items()}
+    result = {
+        "metric": (
+            f"speculative-decoding + chunked-prefill quick bench (tiny LM, "
+            f"CPU, {requests} requests x {max_new} new tokens, {slots} "
+            f"slots, k={spec_k}, chunk={prefill_chunk})"
+        ),
+        "prompt_mix": prompt_mix,
+        **variants,
+        # the two acceptance-criteria numbers, precomputed for the gate
+        "tpot_speedup": round(
+            baseline["tpot_s"]["p50"] / spec["tpot_s"]["p50"], 3
+        ),
+        "streams_identical": len(set(digests.values())) == 1,
+        "stream_digests": digests,
     }
     if out_path:
         with open(out_path, "w") as f:
@@ -1604,6 +1714,32 @@ def main(argv=None):
     p.add_argument("--paged-out", default="BENCH_paged.json",
                    help="where --paged writes its JSON")
     p.add_argument("--paged-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--spec", action="store_true",
+                   help="speculative-decoding + chunked-prefill A/B on "
+                        "CPU: baseline vs spec vs chunked vs both, all "
+                        "paged+device+greedy on a mixed prompt mix; "
+                        "asserts token-identical streams and reports the "
+                        "TPOT speedup; writes BENCH_spec.json (no TPU, "
+                        "no probe)")
+    p.add_argument("--spec-requests", type=int, default=16)
+    p.add_argument("--spec-concurrency", type=int, default=6,
+                   help="closed-loop client threads")
+    p.add_argument("--spec-slots", type=int, default=4,
+                   help="engine decode slots")
+    p.add_argument("--spec-max-new", type=int, default=32,
+                   help="tokens per request; long enough that decode "
+                        "dispatches (what speculation amortises) dominate "
+                        "each request's TPOT window over its one-off "
+                        "prefill share")
+    p.add_argument("--spec-k", type=int, default=7,
+                   help="draft tokens per slot per verify dispatch")
+    p.add_argument("--spec-prefill-chunk", type=int, default=8,
+                   help="prompt tokens streamed per chunked-prefill tick")
+    p.add_argument("--spec-page-size", type=int, default=8,
+                   help="tokens per KV page")
+    p.add_argument("--spec-queue-depth", type=int, default=4)
+    p.add_argument("--spec-out", default="BENCH_spec.json",
+                   help="where --spec writes its JSON")
     p.add_argument("--fleet", action="store_true",
                    help="fleet resilience bench on CPU: 2 supervised "
                         "replicas behind the router, one SIGKILLed "
@@ -1657,6 +1793,20 @@ def main(argv=None):
             page_size=args.paged_page_size,
             queue_depth=args.paged_queue_depth,
             out_path=args.paged_out,
+        )
+        print(json.dumps(result))
+        return result
+    if args.spec:
+        result = run_spec(
+            requests=args.spec_requests,
+            concurrency=args.spec_concurrency,
+            slots=args.spec_slots,
+            max_new=args.spec_max_new,
+            spec_k=args.spec_k,
+            prefill_chunk=args.spec_prefill_chunk,
+            page_size=args.spec_page_size,
+            queue_depth=args.spec_queue_depth,
+            out_path=args.spec_out,
         )
         print(json.dumps(result))
         return result

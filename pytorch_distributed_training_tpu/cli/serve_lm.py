@@ -74,6 +74,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="token selection: device (in-jit sampling, [slots] "
                         "int32 D2H per tick) or host (fp32 logits D2H + np "
                         "sampling — the pinned reference path)")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: draft tokens proposed per "
+                        "slot per tick (0 = off); each verify dispatch "
+                        "scores k+1 positions and commits every accepted "
+                        "one — same token stream, fewer dispatches "
+                        "(requires --kv-layout paged --sampling device)")
+    p.add_argument("--draft-checkpoint", default=None,
+                   help="trainer-format checkpoint dir for a small DRAFT "
+                        "model that proposes the speculative tokens; "
+                        "without it --spec-k falls back to the built-in "
+                        "n-gram (prompt-lookup) drafter")
+    p.add_argument("--draft-model", default="gpt2-tiny",
+                   help="model preset for --draft-checkpoint (the draft's "
+                        "vocab must match the base model's)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill: stream prompts into the paged KV "
+                        "cache this many tokens per tick through one "
+                        "compiled program (0 = one jitted prefill per "
+                        "bucket); long prompts stop monopolising the tick "
+                        "loop and new buckets stop triggering compiles")
     p.add_argument("--warmup", action="store_true",
                    help="compile every prefill bucket + the decode step "
                         "before serving (first request pays no compile; "
@@ -149,6 +169,21 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
     tok = build_tokenizer(args)
     model, params, boot_step = load_model_and_params(args, tok)
 
+    draft_model = draft_params = None
+    spec_draft = "ngram"
+    if args.spec_k > 0 and args.draft_checkpoint:
+        # the draft lane reuses the full checkpoint-loading machinery on a
+        # cloned namespace: verified-step resolution, scanned-trunk probes
+        # and vocab checks all apply to the draft exactly as to the base
+        draft_args = argparse.Namespace(**{
+            **vars(args),
+            "model": args.draft_model,
+            "checkpoint_dir": args.draft_checkpoint,
+            "hf_checkpoint": None,
+        })
+        draft_model, draft_params, _ = load_model_and_params(draft_args, tok)
+        spec_draft = "model"
+
     registry = get_registry()
     sink = None
     if args.metrics_dir:
@@ -167,6 +202,9 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             "page_size": args.page_size,
             "num_pages": args.num_pages,
             "sampling": args.sampling,
+            "spec_k": args.spec_k,
+            "spec_draft": spec_draft if args.spec_k > 0 else None,
+            "prefill_chunk": args.prefill_chunk,
         })
 
     config = EngineConfig(
@@ -180,6 +218,9 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         num_pages=args.num_pages,
         sampling=args.sampling,
         warmup=args.warmup,
+        spec_k=args.spec_k,
+        spec_draft=spec_draft,
+        prefill_chunk=args.prefill_chunk,
     )
     from pytorch_distributed_training_tpu.analysis.concurrency import (
         get_lock_registry,
@@ -204,6 +245,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         guards=GuardSet(mode=guard_mode, registry=registry),
         stall_timeout_s=args.stall_timeout_s,
         weights_step=boot_step,
+        draft_model=draft_model,
+        draft_params=draft_params,
     ).start()
 
     lock_summary = None

@@ -238,15 +238,19 @@ class BertSelfAttention(nn.Module):
         operands per call; only the pools are engine-resident state.
 
         Contract with the engine:
-        - prefill (chunk > 1): the sequence is FRESH (context_len == 0) and
-          its block table row covers the chunk; K/V is scattered into its
-          pages and attention is intra-chunk causal — bitwise the dense
-          cache formula at idx == 0.
+        - prefill (chunk > 1, paged_multiquery=False): the sequence is
+          FRESH (context_len == 0) and its block table row covers the
+          chunk; K/V is scattered into its pages and attention is
+          intra-chunk causal — bitwise the dense cache formula at idx == 0.
         - decode (chunk == 1): one token appended at ``context_len``, then
           ops/paged_attention gathers the whole context through the block
           table. Idle batch rows park on the reserved null page 0: their
           writes land there and their outputs are garbage the host ignores
           (no lax.select freeze needed — page structure isolates them).
+        - multi-token query (paged_multiquery=True): the chunk is appended
+          at ``context_len`` of an EXISTING context (speculative verify /
+          chunked-prefill continuation) and attends causally over prior
+          pages plus itself through the 4-D-query paged_attention path.
         """
         cfg = self.config
         if not cfg.causal:
@@ -306,6 +310,20 @@ class BertSelfAttention(nn.Module):
                 scale=scale, impl=cfg.paged_attention_impl,
             )
             return out[:, None]
+        if cfg.paged_multiquery:
+            # Multi-token query over an existing context: the chunk's rows
+            # sit at positions idx..idx+chunk-1 and see everything written
+            # up to themselves (lengths inclusive of the chunk). Used by
+            # the engine's speculative-verify and chunked-prefill programs.
+            if attention_bias is not None:
+                raise ValueError(
+                    "paged multiquery attention takes no attention bias "
+                    "(padding is expressed through context_len)"
+                )
+            return paged_attention(
+                q, kp.value, vp.value, bt.value, idx + chunk,
+                scale=scale, impl=cfg.paged_attention_impl,
+            )
         # Prefill: fresh sequence (idx == 0 by engine contract), so the
         # visible context IS this chunk — attend intra-chunk with the exact
         # dense-cache formula (fp32 scores, finfo.min mask, fp32 softmax)

@@ -1,20 +1,26 @@
-"""Paged decode attention: single-query attention that gathers K/V through
-a block table over fixed-size pages (vLLM PagedAttention layout).
+"""Paged decode attention: attention that gathers K/V through a block table
+over fixed-size pages (vLLM PagedAttention layout).
 
 Shapes
 ------
 - ``q``:          [batch, heads, head_dim] — ONE query token per sequence
-                  (this is a decode-step kernel; prefill attends intra-chunk
-                  and never calls it).
+                  (the classic decode step), or
+                  [batch, q_len, heads, head_dim] — a multi-token query block
+                  (speculative verify / chunked prefill). The q_len tokens
+                  are the LAST q_len positions of the sequence and attend
+                  causally: query row ``j`` sees positions
+                  ``< lengths - q_len + 1 + j``.
 - ``k_pages``/``v_pages``: [num_pages, page_size, heads, head_dim] — the
                   engine-resident page pools. Page 0 is the reserved null
                   page (see serve/paged_cache.py); idle sequences park their
                   block table on it.
 - ``block_table``: [batch, pages_per_seq] int32 — page ids per sequence, in
                   token order; entries past the live length point at page 0.
-- ``lengths``:    [batch] int32 — valid tokens per sequence INCLUSIVE of the
-                  current query token (the engine writes the new K/V before
-                  attending, so position ``lengths-1`` is the query itself).
+- ``lengths``:    [batch] int32 — valid tokens per sequence INCLUSIVE of all
+                  query tokens (the engine writes the new K/V before
+                  attending, so positions ``lengths-q_len .. lengths-1`` are
+                  the query block itself). ``lengths >= q_len`` is an engine
+                  contract: every query row has at least one visible token.
 
 Two implementations behind one signature:
 
@@ -55,10 +61,15 @@ def paged_attention(
     scale: float,
     impl: str = "reference",
 ) -> jax.Array:
-    """Single-token attention through a page table. Returns [batch, heads,
-    head_dim] in ``v_pages.dtype`` (the dense path's output dtype)."""
-    if q.ndim != 3:
-        raise ValueError(f"q must be [batch, heads, head_dim], got {q.shape}")
+    """Attention through a page table. 3-D ``q`` is the single-token decode
+    step (returns [batch, heads, head_dim]); 4-D ``q`` is a causal
+    multi-token query block (returns [batch, q_len, heads, head_dim]).
+    Output dtype is ``v_pages.dtype`` (the dense path's output dtype)."""
+    if q.ndim not in (3, 4):
+        raise ValueError(
+            f"q must be [batch, heads, head_dim] or "
+            f"[batch, q_len, heads, head_dim], got {q.shape}"
+        )
     if k_pages.shape != v_pages.shape:
         raise ValueError(
             f"k_pages/v_pages shapes differ: {k_pages.shape} vs {v_pages.shape}"
@@ -73,6 +84,16 @@ def paged_attention(
             f"lengths must be [batch], got {lengths.shape} for batch "
             f"{q.shape[0]}"
         )
+    if q.ndim == 4:
+        if impl == "reference":
+            return _paged_reference_mq(
+                q, k_pages, v_pages, block_table, lengths, scale
+            )
+        if impl == "pallas":
+            return _paged_pallas_mq(
+                q, k_pages, v_pages, block_table, lengths, scale
+            )
+        raise ValueError(f"unknown paged attention impl {impl!r}")
     if impl == "reference":
         return _paged_reference(q, k_pages, v_pages, block_table, lengths, scale)
     if impl == "pallas":
@@ -219,6 +240,160 @@ def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale):
                 pltpu.VMEM((heads, _LANES), jnp.float32),
                 pltpu.VMEM((heads, _LANES), jnp.float32),
                 pltpu.VMEM((heads, head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, v_pages.dtype),
+        interpret=_interpreting(),
+    )(block_table, lengths, q, k_pages, v_pages)
+    return out
+
+
+# ------------------------------------------------- multi-token query block
+#
+# Shared by speculative verify (q_len = k+1 candidate tokens) and chunked
+# prefill (q_len = chunk tokens appended to an existing context). The query
+# block occupies the LAST q_len positions of the sequence, so row j's causal
+# horizon is ``pos < lengths - q_len + 1 + j``. With q_len == 1 this reduces
+# to the single-query mask above; the 3-D paths are kept verbatim so the
+# decode-step numerics (and their token-identity pins) cannot move.
+
+
+def _paged_reference_mq(q, k_pages, v_pages, block_table, lengths, scale):
+    batch, q_len, heads, head_dim = q.shape
+    _, page_size, _, _ = k_pages.shape
+    windows = block_table.shape[1]
+
+    k = k_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+    v = v_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+
+    scores = (
+        jnp.einsum("bqnd,btnd->bnqt", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    pos = jax.lax.broadcasted_iota(jnp.int32, (batch, q_len, windows * page_size), 2)
+    row = jax.lax.broadcasted_iota(jnp.int32, (batch, q_len, windows * page_size), 1)
+    limit = lengths[:, None, None] - (q_len - 1) + row
+    valid = pos < limit
+    scores = jnp.where(valid[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bnqt,btnd->bqnd", probs, v)
+
+
+def _paged_kernel_mq(
+    bt_ref,  # scalar-prefetch: [B, W] int32
+    len_ref,  # scalar-prefetch: [B] int32
+    q_ref,  # [1, Q, H, D]
+    k_ref,  # [1, P, H, D]
+    v_ref,  # [1, P, H, D]
+    o_ref,  # [1, Q, H, D]
+    m_ref,  # VMEM [H, Q, LANES] f32
+    l_ref,  # VMEM [H, Q, LANES] f32
+    acc_ref,  # VMEM [H, Q, D] f32
+    *,
+    scale: float,
+    page_size: int,
+    windows: int,
+    q_len: int,
+):
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+    length = len_ref[b]
+
+    @pl.when(w == 0)
+    def _init():
+        # finfo.min, NOT -inf: a computed page can be fully masked for the
+        # earliest query rows (their causal horizon ends before the page),
+        # and exp(-inf - -inf) would NaN-poison the rescale. With a finite
+        # floor the masked-row algebra stays exact: p is where()-zeroed, so
+        # l stays 0 until the first visible token.
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # The last valid token overall sits at length-1 (row q_len-1's horizon),
+    # so pages at or past `length` carry nothing for any row.
+    @pl.when(w * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [Q, H, D]
+        k = k_ref[0].astype(jnp.float32)  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)  # [P, H, D]
+
+        # [H, Q, P]: batch over heads (q dim 1 / k dim 1), contract head_dim.
+        s = (
+            jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((1,), (1,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        pos = w * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = pos < length - (q_len - 1) + row
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[...][:, :, :1]  # [H, Q, 1]
+        l_prev = l_ref[...][:, :, :1]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # where(), not bare exp: on an all-masked row m_new == _NEG_INF and
+        # exp(s - m_new) would be exp(0) == 1 per lane.
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [H, Q, P]
+        l_new = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
+        # [H, Q, D]: batch over heads (p dim 0 / v dim 1), contract lanes.
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(w == windows - 1)
+    def _write():
+        l = l_ref[...][:, :, :1]
+        l = jnp.where(l > 0.0, l, 1.0)
+        out = acc_ref[...] / l  # [H, Q, D]
+        o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+
+
+def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale):
+    batch, q_len, heads, head_dim = q.shape
+    _, page_size, _, _ = k_pages.shape
+    windows = block_table.shape[1]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel_mq,
+            scale=scale,
+            page_size=page_size,
+            windows=windows,
+            q_len=q_len,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, windows),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, q_len, heads, head_dim),
+                    lambda b, w, bt, ln: (b, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, page_size, heads, head_dim),
+                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, page_size, heads, head_dim),
+                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, q_len, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((heads, q_len, _LANES), jnp.float32),
+                pltpu.VMEM((heads, q_len, _LANES), jnp.float32),
+                pltpu.VMEM((heads, q_len, head_dim), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, v_pages.dtype),

@@ -51,6 +51,33 @@ Integration: prefill/decode dispatch+block run under
 tick-level queue-depth/slot-occupancy and per-tick
 ``kv_pages_used``/``kv_pages_free`` go through ``telemetry/``.
 
+**Speculative decoding** (``EngineConfig.spec_k > 0``, paged + device
+sampling only): a cheap draft lane proposes k tokens per slot per tick —
+either host-side n-gram self-drafting (``spec_draft="ngram"``, zero extra
+dispatches: prompt-lookup over the slot's own history) or a small draft
+model resident beside the base model (``spec_draft="model"``, greedy
+single-token draft dispatches sharing the allocator's block table into
+separate draft pools). ONE jitted verify dispatch then scores all k+1
+positions (pending token + k drafts) through the multi-token-query paged
+attention path and runs exact-match acceptance sampling on device
+(``serve/sampling.spec_accept``): every emitted token is literally the
+``fold_in(key(seed), step)`` stream's sample for its position, so the
+accepted stream is BIT-IDENTICAL to the non-speculative stream for greedy
+and fixed-seed sampling — the draft only controls how many positions one
+dispatch commits. Rejected drafts roll back by the host simply NOT
+advancing the slot's context cursor past the accepted prefix: the dead
+K/V lanes stay in the slot's over-reserved pages (see
+``PageAllocator.pages_reserved``), masked by ``context_len`` and
+overwritten on reuse — zero allocator churn.
+
+**Chunked prefill** (``EngineConfig.prefill_chunk > 0``): prompts stream
+into their pages ``prefill_chunk`` tokens per tick through the same
+multi-token-query program (ONE compiled chunk program replaces the
+one-jitted-prefill-per-bucket scheme), interleaving with decode ticks so
+a long prompt's prefill no longer stalls short requests' decode;
+``prefill_concurrency`` caps mid-prefill residency via the queue's
+``defer`` hold (a hold is not page exhaustion).
+
 Live weight hot-swap (serve/hotswap.py): ``request_swap(params, version)``
 queues a validated replacement params tree from any thread; the serve
 loop applies it at the START of the next tick (``swap_params`` — never
@@ -96,7 +123,10 @@ from pytorch_distributed_training_tpu.serve.queue import (
     RequestQueue,
     emit_expiry,
 )
-from pytorch_distributed_training_tpu.serve.sampling import device_sample
+from pytorch_distributed_training_tpu.serve.sampling import (
+    device_sample,
+    spec_accept,
+)
 from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -136,6 +166,22 @@ class EngineConfig:
     # first request never pays compilation and strict tick-wide transfer
     # scoping arms from the first real tick.
     warmup: bool = False
+    # Speculative decoding: draft tokens proposed per slot per tick; 0
+    # disables (the legacy one-token decode program runs unchanged).
+    # Requires kv_layout="paged" + sampling="device".
+    spec_k: int = 0
+    # Draft lane: "ngram" = host-side prompt-lookup self-drafting (no
+    # draft checkpoint, zero extra dispatches); "model" = a small draft
+    # model passed to the engine (greedy draft dispatches per tick).
+    spec_draft: str = "ngram"
+    # Chunked prefill: prompt tokens scattered per tick per slot; 0 keeps
+    # the monolithic per-bucket prefill programs. Requires paged + device
+    # sampling.
+    prefill_chunk: int = 0
+    # Max slots simultaneously mid-chunked-prefill; further admissions are
+    # DEFERRED (transient queue hold, not page exhaustion) until a
+    # streaming prompt finishes.
+    prefill_concurrency: int = 1
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -160,6 +206,33 @@ class EngineConfig:
             )
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_draft not in ("ngram", "model"):
+            raise ValueError(
+                f"spec_draft must be ngram/model, got {self.spec_draft!r}"
+            )
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}"
+            )
+        if self.prefill_concurrency < 1:
+            raise ValueError(
+                f"prefill_concurrency must be >= 1, got "
+                f"{self.prefill_concurrency}"
+            )
+        if self.spec_k > 0 or self.prefill_chunk > 0:
+            # both features ride the multi-token-query paged program and
+            # in-jit sampling; the dense/host combinations stay the plain
+            # baseline (that's what the A/B benches compare against)
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "spec_k/prefill_chunk require kv_layout='paged'"
+                )
+            if self.sampling != "device":
+                raise ValueError(
+                    "spec_k/prefill_chunk require sampling='device'"
+                )
         if self.kv_layout == "paged" and self.num_pages > 0:
             if self.num_pages < self.pages_per_slot + 1:
                 raise ValueError(
@@ -175,8 +248,11 @@ class EngineConfig:
 
     @property
     def pages_per_slot(self) -> int:
-        """Block-table row width: pages covering one worst-case request."""
-        return -(-self.cache_len // self.page_size)
+        """Block-table row width: pages covering one worst-case request
+        INCLUDING the speculative overshoot (a verify tick scatters up to
+        ``spec_k`` draft tokens past the committed context before
+        acceptance is known — see ``PageAllocator.pages_reserved``)."""
+        return -(-(self.cache_len + self.spec_k) // self.page_size)
 
     @property
     def total_pages(self) -> int:
@@ -207,7 +283,15 @@ class _Slot:
 
     request: GenRequest
     pending_token: int          # sampled, not yet fed through decode
-    steps_done: int = 0         # decode steps already executed for this slot
+    steps_done: int = 0         # generated tokens already fed into the KV
+    # chunked prefill: "prefill" while the prompt is still streaming into
+    # the slot's pages (prefill_pos tokens scattered so far), "decode" once
+    # the first token is sampled
+    phase: str = "decode"
+    prefill_pos: int = 0
+    # speculative lane membership (request opt-in/out resolved against the
+    # engine default at admission; fixed for the slot's lifetime)
+    spec: bool = False
 
 
 @dataclasses.dataclass
@@ -248,6 +332,8 @@ class DecodeEngine:
         registry=None,
         guards: Optional[GuardSet] = None,
         weights_step: Optional[int] = None,
+        draft_model=None,
+        draft_params=None,
     ):
         cfg = model.config
         if not cfg.causal:
@@ -263,12 +349,14 @@ class DecodeEngine:
             model = type(model)(cfg)
             params = unstack_scanned_params(params)
         self.config = config
-        if config.cache_len > cfg.max_position_embeddings:
+        if config.cache_len + config.spec_k > cfg.max_position_embeddings:
             raise ValueError(
                 f"cache_len {config.cache_len} (= largest bucket "
                 f"{config.prompt_buckets[-1]} + max_new_tokens "
-                f"{config.max_new_tokens}) exceeds max_position_embeddings "
-                f"{cfg.max_position_embeddings}"
+                f"{config.max_new_tokens}) + spec_k {config.spec_k} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings} "
+                f"(speculative drafts occupy positions past the committed "
+                f"context before acceptance is known)"
             )
         paged = config.kv_layout == "paged"
         dcfg = dataclasses.replace(cfg, decode=True, kv_layout=config.kv_layout)
@@ -280,6 +368,63 @@ class DecodeEngine:
                 paged_attention_impl=config.paged_attention_impl,
             )
         self._decode_model = type(model)(dcfg)
+        # Multi-token-query view of the SAME decode model (shared params,
+        # shared pools): the verify and chunk programs append a block of
+        # tokens at context_len and attend over prior pages plus the block.
+        # A separate view — not a flag flip on _decode_model — so the
+        # chunk==1 decode program and its bitwise pins are untouched.
+        self._mq_model = None
+        if paged and (config.spec_k > 0 or config.prefill_chunk > 0):
+            self._mq_model = type(model)(
+                dataclasses.replace(dcfg, paged_multiquery=True)
+            )
+        # Draft lane (spec_draft="model"): a small model resident beside
+        # the base one, with its OWN page pools at the SAME page geometry
+        # so the allocator's block tables address both. "ngram" drafting
+        # needs no device state at all.
+        self._draft_model = None
+        self._draft_mq_model = None
+        self._draft_params = None
+        self._draft_cache = None
+        if config.spec_k > 0 and config.spec_draft == "model":
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "spec_draft='model' needs draft_model/draft_params "
+                    "(pass spec_draft='ngram' for checkpoint-free "
+                    "self-drafting)"
+                )
+            dmc = draft_model.config
+            if dmc.scan_layers:
+                from pytorch_distributed_training_tpu.models.relayout import (
+                    unstack_scanned_params,
+                )
+
+                dmc = dataclasses.replace(dmc, scan_layers=False)
+                draft_params = unstack_scanned_params(draft_params)
+            if dmc.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dmc.vocab_size} != base vocab "
+                    f"{cfg.vocab_size} — draft tokens must be base tokens"
+                )
+            if config.cache_len + config.spec_k > dmc.max_position_embeddings:
+                raise ValueError(
+                    f"draft max_position_embeddings "
+                    f"{dmc.max_position_embeddings} cannot cover cache_len "
+                    f"{config.cache_len} + spec_k {config.spec_k}"
+                )
+            ddcfg = dataclasses.replace(
+                dmc, decode=True, kv_layout="paged",
+                kv_page_size=config.page_size,
+                kv_num_pages=config.total_pages,
+                paged_attention_impl=config.paged_attention_impl,
+                scan_layers=False,
+            )
+            self._draft_model = type(draft_model)(ddcfg)
+            if config.prefill_chunk > 0:
+                self._draft_mq_model = type(draft_model)(
+                    dataclasses.replace(ddcfg, paged_multiquery=True)
+                )
+            self._draft_params = jax.device_put(draft_params)
         # explicit placement: restored checkpoints arrive as host arrays,
         # and a host tree reaching the warm compiled calls would be an
         # implicit per-tick H2D (a strict-mode transfer violation)
@@ -331,6 +476,18 @@ class DecodeEngine:
                 config.total_pages, config.page_size,
                 config.pages_per_slot, config.num_slots,
             )
+            if self._draft_model is not None:
+                dshapes = jax.eval_shape(
+                    lambda: self._draft_model.init(
+                        jax.random.key(0),
+                        jnp.ones((1, 1), jnp.int32),
+                        position_ids=jnp.zeros((1, 1), jnp.int32),
+                    )
+                )["cache"]
+                self._draft_cache = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype),
+                    strip_tables(dshapes),
+                )
         else:
             # Per-slot cache template comes from a batch-1 abstract init at
             # the full cache length (no params materialized); the resident
@@ -349,6 +506,18 @@ class DecodeEngine:
         self._slots: list[Optional[_Slot]] = [None] * config.num_slots
         self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
         self._decode_fn = None
+        self._verify_fn_ = None         # spec_k > 0: the k+1-position program
+        self._chunk_fn_ = None          # prefill_chunk > 0: the chunk program
+        self._draft_decode_fn_ = None   # spec_draft="model" programs
+        self._draft_prefill_fns: dict[int, object] = {}
+        self._draft_chunk_fn_ = None
+        # speculation / chunked-prefill accounting (stats() + telemetry)
+        self.spec_dispatches = 0        # verify dispatches executed
+        self.spec_drafted = 0           # draft tokens proposed
+        self.spec_accepted = 0          # draft tokens accepted
+        self.decode_dispatches = 0      # decode-phase dispatches (any kind)
+        self.decode_tokens = 0          # tokens emitted by decode-phase work
+        self.prefill_chunks = 0         # chunk dispatches executed
         self._last_logits = np.zeros(
             (config.num_slots, cfg.vocab_size), np.float32
         )
@@ -372,11 +541,13 @@ class DecodeEngine:
         """Expected-collective manifest for one serve program: today's
         engine is single-device by construction (no mesh), so the pinned
         contract is ZERO collectives. The audit costs one extra compile
-        per program, so only the DECODE program of a warmed engine is
-        audited — it's the steady-state hot loop, and the per-bucket
+        per program, so only the steady-state hot program of a warmed
+        engine is audited — the single-token decode step, or the verify
+        program when speculation replaces it — and the per-bucket/chunk
         prefills share its partitioning story (and already carry
         donation audits). Tests that skip warmup skip the manifest too."""
-        if not self.config.warmup or name != "serve_decode":
+        hot = "serve_verify" if self.config.spec_k > 0 else "serve_decode"
+        if not self.config.warmup or name != hot:
             return None
         return serve_manifest(1, name=name)
 
@@ -558,6 +729,178 @@ class DecodeEngine:
         )
         return self._decode_fn
 
+    def _verify_fn(self):
+        """ONE jitted program scoring all ``spec_k + 1`` positions per slot
+        and running exact-match acceptance on device (paged + device
+        sampling by config contract).
+
+        ``(params, pools, tokens, bt, ctx, seeds, steps0, temps, top_ks)``
+        with ``tokens`` [slots, k+1] int32 — row = [pending, d1..dk] — and
+        ``ctx`` [slots] the committed context length. The block is
+        scattered at positions ctx..ctx+k and attends through the
+        multi-token-query paged path; ``spec_accept`` samples every
+        position with its own fold-in stream. Returns ``((target
+        [slots, k+1], accept [slots]) int32, new pools)`` — the tick's
+        whole D2H. Rejected drafts are "rolled back" by the HOST simply
+        not advancing ctx past the accepted prefix; their K/V lanes are
+        dead (masked by context_len) until overwritten.
+        """
+        if self._verify_fn_ is not None:
+            return self._verify_fn_
+        q_len = self.config.spec_k + 1
+
+        def verify(params, pools, tokens, bt, ctx, seeds, steps0, temps,
+                   top_ks):
+            cache = with_tables(pools, bt, ctx)
+            logits, vars_ = self._mq_model.apply(
+                {"params": params, "cache": cache},
+                tokens,
+                position_ids=ctx[:, None]
+                + jnp.arange(q_len, dtype=jnp.int32)[None, :],
+                mutable=["cache"],
+            )
+            new_pools = strip_tables(vars_["cache"])
+            target, accept = spec_accept(
+                logits.astype(jnp.float32), tokens[:, 1:],
+                seeds, steps0, temps, top_ks,
+            )
+            return (target, accept), new_pools
+
+        self._verify_fn_ = self._guards.wrap_jit(
+            "serve_verify",
+            jax.jit(verify, donate_argnums=(1,)),
+            audit_donation=True,
+            comm_manifest=self._serve_manifest("serve_verify"),
+        )
+        return self._verify_fn_
+
+    def _chunk_fn(self):
+        """ONE jitted chunked-prefill program shared by every bucket and
+        every chunk index (first, middle, ragged-last — the host pads the
+        last chunk; pad lanes are invisible to real rows by the causal
+        horizon and to later ticks by context_len, the same argument as
+        monolithic-prefill padding).
+
+        ``(params, pools, ids, ctx0, sample_idx, bt_row, seed, temp,
+        top_k)`` — ids [1, C] int32, ctx0 [1] int32 (tokens already
+        scattered), sample_idx scalar int32 (chunk-local row of the
+        prompt's LAST real token; only the final chunk's sample is used by
+        the host). Returns ``(token_id, new pools)``.
+        """
+        if self._chunk_fn_ is not None:
+            return self._chunk_fn_
+        C = self.config.prefill_chunk
+
+        def chunk(params, pools, ids, ctx0, sample_idx, bt_row, seed, temp,
+                  top_k):
+            cache = with_tables(pools, bt_row, ctx0)
+            logits, vars_ = self._mq_model.apply(
+                {"params": params, "cache": cache},
+                ids,
+                position_ids=ctx0[:, None]
+                + jnp.arange(C, dtype=jnp.int32)[None, :],
+                mutable=["cache"],
+            )
+            new_pools = strip_tables(vars_["cache"])
+            last = jnp.take_along_axis(
+                logits, sample_idx[None, None, None], axis=1
+            )[0, 0, :].astype(jnp.float32)
+            token = device_sample(
+                last[None], seed[None], jnp.zeros((1,), jnp.int32),
+                temp[None], top_k[None],
+            )[0]
+            return token, new_pools
+
+        self._chunk_fn_ = self._guards.wrap_jit(
+            "serve_chunk",
+            jax.jit(chunk, donate_argnums=(1,)),
+            audit_donation=True,
+            comm_manifest=self._serve_manifest("serve_chunk"),
+        )
+        return self._chunk_fn_
+
+    def _draft_decode_fn(self):
+        """Greedy single-token decode on the DRAFT model (spec_draft=
+        "model"): same batched shape as the base decode step, writing into
+        the draft pools through the shared block tables. Run ``spec_k + 1``
+        times per tick (re-feeding the last committed token first, so the
+        draft cache self-heals whatever the previous tick's acceptance
+        was), collecting the k draft proposals."""
+        if self._draft_decode_fn_ is not None:
+            return self._draft_decode_fn_
+
+        def draft_decode(params, pools, tokens, bt, ctx):
+            cache = with_tables(pools, bt, ctx)
+            logits, vars_ = self._draft_model.apply(
+                {"params": params, "cache": cache},
+                tokens[:, None],
+                position_ids=ctx[:, None],
+                mutable=["cache"],
+            )
+            new_pools = strip_tables(vars_["cache"])
+            token = jnp.argmax(
+                logits[:, 0, :].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            return token, new_pools
+
+        self._draft_decode_fn_ = self._guards.wrap_jit(
+            "serve_draft_decode",
+            jax.jit(draft_decode, donate_argnums=(1,)),
+            audit_donation=True,
+        )
+        return self._draft_decode_fn_
+
+    def _draft_prefill_fn(self, bucket: int):
+        """Prompt prefill into the DRAFT pools (monolithic flavor): the
+        draft lane needs the same committed context as the base model
+        before it can propose continuations. The sampled head is never
+        used — only the scattered K/V matters."""
+        fn = self._draft_prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def draft_prefill(params, pools, ids, bt_row):
+            cache = with_tables(pools, bt_row, jnp.zeros((1,), jnp.int32))
+            _, vars_ = self._draft_model.apply(
+                {"params": params, "cache": cache},
+                ids,
+                position_ids=jnp.arange(bucket, dtype=jnp.int32)[None],
+                mutable=["cache"],
+            )
+            return strip_tables(vars_["cache"])
+
+        fn = self._guards.wrap_jit(
+            f"serve_draft_prefill_b{bucket}",
+            jax.jit(draft_prefill, donate_argnums=(1,)),
+            audit_donation=True,
+        )
+        self._draft_prefill_fns[bucket] = fn
+        return fn
+
+    def _draft_chunk_fn(self):
+        """Chunked-prefill mirror into the DRAFT pools (no sampling)."""
+        if self._draft_chunk_fn_ is not None:
+            return self._draft_chunk_fn_
+        C = self.config.prefill_chunk
+
+        def draft_chunk(params, pools, ids, ctx0, bt_row):
+            cache = with_tables(pools, bt_row, ctx0)
+            _, vars_ = self._draft_mq_model.apply(
+                {"params": params, "cache": cache},
+                ids,
+                position_ids=ctx0[:, None]
+                + jnp.arange(C, dtype=jnp.int32)[None, :],
+                mutable=["cache"],
+            )
+            return strip_tables(vars_["cache"])
+
+        self._draft_chunk_fn_ = self._guards.wrap_jit(
+            "serve_draft_chunk",
+            jax.jit(draft_chunk, donate_argnums=(1,)),
+            audit_donation=True,
+        )
+        return self._draft_chunk_fn_
+
     def _warmup(self) -> None:
         """Compile every serving program (one prefill per bucket + the
         decode step) with null operands before the engine goes live.
@@ -566,47 +909,105 @@ class DecodeEngine:
         every slot inactive — both leave no state a real admit would see.
         Also the precondition for strict tick-wide transfer scoping: after
         warm-up, ``_scope_ready()`` holds from the first real tick."""
+        cfg = self.config
         paged = self._pages is not None
+        W = cfg.pages_per_slot
+        draft = self._draft_model is not None
         outs = []
-        for bucket in self.config.prompt_buckets:
-            if paged:
-                ops = jax.device_put((
-                    np.zeros((1, bucket), np.int32),
-                    np.int32(1),
-                    np.zeros((1, self.config.pages_per_slot), np.int32),
-                    np.int32(0), np.float32(0.0), np.int32(0),
-                ))
-            else:
-                ops = jax.device_put((
-                    np.int32(0),
-                    np.zeros((1, bucket), np.int32),
-                    np.int32(1),
-                    np.int32(0), np.float32(0.0), np.int32(0),
-                ))
-            out, self._cache = self._prefill_fn(bucket)(
+        if paged and cfg.prefill_chunk > 0:
+            # ONE chunk program replaces the whole per-bucket prefill set
+            ops = jax.device_put((
+                np.zeros((1, cfg.prefill_chunk), np.int32),
+                np.zeros((1,), np.int32),
+                np.int32(0),
+                np.zeros((1, W), np.int32),
+                np.int32(0), np.float32(0.0), np.int32(0),
+            ))
+            out, self._cache = self._chunk_fn()(
                 self._params, self._cache, *ops
             )
             outs.append(out)
-        S = self.config.num_slots
-        if paged:
-            ops = jax.device_put((
-                np.zeros((S,), np.int32),
-                np.zeros((S, self.config.pages_per_slot), np.int32),
-                np.zeros((S,), np.int32),
-                np.zeros((S,), np.int32), np.zeros((S,), np.int32),
-                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-            ))
+            if draft:
+                dops = jax.device_put((
+                    np.zeros((1, cfg.prefill_chunk), np.int32),
+                    np.zeros((1,), np.int32),
+                    np.zeros((1, W), np.int32),
+                ))
+                self._draft_cache = self._draft_chunk_fn()(
+                    self._draft_params, self._draft_cache, *dops
+                )
         else:
+            for bucket in cfg.prompt_buckets:
+                if paged:
+                    ops = jax.device_put((
+                        np.zeros((1, bucket), np.int32),
+                        np.int32(1),
+                        np.zeros((1, W), np.int32),
+                        np.int32(0), np.float32(0.0), np.int32(0),
+                    ))
+                else:
+                    ops = jax.device_put((
+                        np.int32(0),
+                        np.zeros((1, bucket), np.int32),
+                        np.int32(1),
+                        np.int32(0), np.float32(0.0), np.int32(0),
+                    ))
+                out, self._cache = self._prefill_fn(bucket)(
+                    self._params, self._cache, *ops
+                )
+                outs.append(out)
+                if draft:
+                    dops = jax.device_put((
+                        np.zeros((1, bucket), np.int32),
+                        np.zeros((1, W), np.int32),
+                    ))
+                    self._draft_cache = self._draft_prefill_fn(bucket)(
+                        self._draft_params, self._draft_cache, *dops
+                    )
+        S = cfg.num_slots
+        if paged and cfg.spec_k > 0:
+            # verify replaces the single-token decode step entirely
             ops = jax.device_put((
+                np.zeros((S, cfg.spec_k + 1), np.int32),
+                np.zeros((S, W), np.int32),
                 np.zeros((S,), np.int32),
-                np.zeros((S,), bool),
                 np.zeros((S,), np.int32), np.zeros((S,), np.int32),
                 np.zeros((S,), np.float32), np.zeros((S,), np.int32),
             ))
-        out, self._cache = self._decode_step_fn()(
-            self._params, self._cache, *ops
-        )
-        outs.append(out)
+            out, self._cache = self._verify_fn()(
+                self._params, self._cache, *ops
+            )
+            outs.append(out)
+            if draft:
+                dops = jax.device_put((
+                    np.zeros((S,), np.int32),
+                    np.zeros((S, W), np.int32),
+                    np.zeros((S,), np.int32),
+                ))
+                dout, self._draft_cache = self._draft_decode_fn()(
+                    self._draft_params, self._draft_cache, *dops
+                )
+                outs.append(dout)
+        else:
+            if paged:
+                ops = jax.device_put((
+                    np.zeros((S,), np.int32),
+                    np.zeros((S, W), np.int32),
+                    np.zeros((S,), np.int32),
+                    np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                ))
+            else:
+                ops = jax.device_put((
+                    np.zeros((S,), np.int32),
+                    np.zeros((S,), bool),
+                    np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                ))
+            out, self._cache = self._decode_step_fn()(
+                self._params, self._cache, *ops
+            )
+            outs.append(out)
         # ONE sync for the whole warm-up batch (compiles are synchronous at
         # dispatch; this only drains the null executions)
         jax.block_until_ready(outs)
@@ -619,13 +1020,23 @@ class DecodeEngine:
         ``warmup=True`` is for)."""
         if self.config.sampling != "device":
             return False
-        if self._decode_fn is None or not self._decode_fn.warm:
-            return False
-        for bucket in self.config.prompt_buckets:
-            fn = self._prefill_fns.get(bucket)
-            if fn is None or not fn.warm:
-                return False
-        return True
+        required = []
+        if self.config.spec_k > 0:
+            required.append(self._verify_fn_)
+            if self._draft_model is not None:
+                required.append(self._draft_decode_fn_)
+        else:
+            required.append(self._decode_fn)
+        if self.config.prefill_chunk > 0:
+            required.append(self._chunk_fn_)
+            if self._draft_model is not None:
+                required.append(self._draft_chunk_fn_)
+        else:
+            for bucket in self.config.prompt_buckets:
+                required.append(self._prefill_fns.get(bucket))
+                if self._draft_model is not None:
+                    required.append(self._draft_prefill_fns.get(bucket))
+        return all(fn is not None and fn.warm for fn in required)
 
     # ------------------------------------------------------------- hot swap
 
@@ -851,18 +1262,64 @@ class DecodeEngine:
         if self._pages is not None:
             self._pages.release(slot)
 
+    def _pages_for(self, req: GenRequest) -> int:
+        """Up-front page reservation for one request: the worst case —
+        bucket + the request's max_new_tokens — plus the speculative
+        overshoot (``spec_k`` draft positions scattered past the committed
+        context before acceptance is known; reserved for EVERY request
+        when speculation is on, since non-spec slots ride the same verify
+        dispatch and its scatter). This is the documented budget formula:
+        with it, ``page_exhausted`` can never fire for an admitted slot."""
+        return self._pages.pages_reserved(
+            req.bucket + req.max_new_tokens, self.config.spec_k
+        )
+
     def _admission_fits(self, req: GenRequest) -> bool:
         """Page-budget admission predicate (``RequestQueue.pop_ready``):
-        the whole worst case — bucket + the request's max_new_tokens — must
-        be allocatable up front, so an admitted request can never starve
-        mid-decode. Dense layout admits on slot availability alone."""
+        the whole worst case must be allocatable up front, so an admitted
+        request can never starve mid-decode. Dense layout admits on slot
+        availability alone."""
         if self._pages is None:
             return True
-        need = self._pages.pages_needed(req.bucket + req.max_new_tokens)
-        if self._pages.can_alloc(need):
+        if self._pages.can_alloc(self._pages_for(req)):
             return True
         self._page_blocked = True
         return False
+
+    def _prefill_resident(self) -> int:
+        return sum(
+            1 for s in self._slots if s is not None and s.phase == "prefill"
+        )
+
+    def _admission_defer(self, req: GenRequest) -> bool:
+        """Transient chunked-prefill residency hold (``pop_ready(defer=)``):
+        while ``prefill_concurrency`` slots are still streaming prompts in,
+        new admissions wait a tick. Checked BEFORE the page predicate so a
+        hold never inflates ``page_exhausted`` — the mid-prefill slot keeps
+        getting chunk ticks instead of being starved by admission work."""
+        return self._prefill_resident() >= self.config.prefill_concurrency
+
+    def _slot_spec(self, req: GenRequest) -> bool:
+        """Resolve the request's speculative opt-in/out against the engine
+        default (on whenever spec_k > 0)."""
+        if self.config.spec_k <= 0:
+            return False
+        return req.spec if req.spec is not None else True
+
+    def _admit_chunked(self, req: GenRequest, slot: int) -> None:
+        """Chunked admission: reserve the slot + pages and let the tick
+        loop stream the prompt in ``prefill_chunk`` tokens at a time (the
+        first dispatch happens on the SAME tick via ``_advance_prefills``
+        order — admission itself is pure bookkeeping)."""
+        req.status = "running"
+        req.admit_t = time.monotonic()
+        self.admitted += 1
+        self._registry.inc("serve/admitted")
+        self._pages.admit(slot, self._pages_for(req))
+        self._slots[slot] = _Slot(
+            request=req, pending_token=-1, phase="prefill",
+            prefill_pos=0, spec=self._slot_spec(req),
+        )
 
     def _admit(self, req: GenRequest, slot: int) -> None:
         """Prefill ``req`` into ``slot`` and take its first token."""
@@ -875,9 +1332,7 @@ class DecodeEngine:
         padded[0, : req.prompt_len] = req.prompt_ids
         paged = self._pages is not None
         if paged:
-            self._pages.admit(
-                slot, self._pages.pages_needed(bucket + req.max_new_tokens)
-            )
+            self._pages.admit(slot, self._pages_for(req))
         try:
             # ONE explicit H2D for all host-built operands (np → device);
             # under the strict tick-wide transfer scope, explicit
@@ -903,6 +1358,17 @@ class DecodeEngine:
                 out, self._cache = self._prefill_fn(bucket)(
                     self._params, self._cache, *ops
                 )
+                if paged and self._draft_model is not None:
+                    # mirror the prompt into the draft pools (same block-
+                    # table row, draft-side K/V) so the draft lane shares
+                    # the slot's committed context from its first tick
+                    dops = jax.device_put((
+                        padded,
+                        self._pages.block_table[slot : slot + 1],
+                    ))
+                    self._draft_cache = self._draft_prefill_fn(bucket)(
+                        self._draft_params, self._draft_cache, *dops
+                    )
                 # explicit d2h (np.asarray would be an implicit transfer —
                 # the exact pattern the transfer guard disallows on chips)
                 fetched = jax.device_get(out)
@@ -920,7 +1386,9 @@ class DecodeEngine:
             if paged:
                 self._pages.release(slot)
             return
-        self._slots[slot] = _Slot(request=req, pending_token=token)
+        self._slots[slot] = _Slot(
+            request=req, pending_token=token, spec=self._slot_spec(req)
+        )
 
     def _is_terminal(self, req: GenRequest, token: int) -> bool:
         """Finish ``req`` if ``token`` completed it; True when finished."""
@@ -931,6 +1399,242 @@ class DecodeEngine:
             self._finish(req, "done", "length")
             return True
         return False
+
+    # ------------------------------------------------------- chunked prefill
+
+    def _advance_prefills(self) -> bool:
+        """Stream one ``prefill_chunk``-token chunk into every mid-prefill
+        slot (one batch-1 dispatch each through the shared chunk program).
+        The final chunk is ragged: ids are zero-padded, the prompt's last
+        real token's row is sampled, and the pad lanes are dead by the
+        causal horizon now and by ``context_len`` forever after — the same
+        argument that makes monolithic-prefill padding safe. On the final
+        chunk the slot flips to decode phase with its first token emitted;
+        decode ticks for OTHER slots keep running between chunks, which is
+        the whole point (a long prompt no longer stalls short requests)."""
+        C = self.config.prefill_chunk
+        chunks = 0
+        for i, s in enumerate(self._slots):
+            if s is None or s.phase != "prefill":
+                continue
+            req = s.request
+            start = s.prefill_pos
+            end = min(start + C, req.prompt_len)
+            ids = np.zeros((1, C), np.int32)
+            ids[0, : end - start] = req.prompt_ids[start:end]
+            is_last = end >= req.prompt_len
+            sample_idx = (
+                np.int32(req.prompt_len - 1 - start) if is_last
+                else np.int32(0)
+            )
+            ops = jax.device_put((
+                ids,
+                np.asarray([start], np.int32),
+                sample_idx,
+                self._pages.block_table[i : i + 1],
+                np.int32(req.seed),
+                np.float32(req.temperature),
+                np.int32(min(req.top_k, np.iinfo(np.int32).max)),
+            ))
+            with watchdog_guard("serve_prefill"):
+                out, self._cache = self._chunk_fn()(
+                    self._params, self._cache, *ops
+                )
+                if self._draft_model is not None:
+                    dops = jax.device_put((
+                        ids,
+                        np.asarray([start], np.int32),
+                        self._pages.block_table[i : i + 1],
+                    ))
+                    self._draft_cache = self._draft_chunk_fn()(
+                        self._draft_params, self._draft_cache, *dops
+                    )
+                fetched = jax.device_get(out) if is_last else None
+            self.prefill_chunks += 1
+            chunks += 1
+            s.prefill_pos = end
+            if is_last:
+                token = int(fetched)
+                self._emit_token(req, token)
+                if self._is_terminal(req, token):
+                    self._evict(i)
+                else:
+                    s.phase = "decode"
+                    s.pending_token = token
+                    s.steps_done = 0
+        if chunks:
+            self._registry.gauge("serve/prefill_chunks", chunks)
+        return chunks > 0
+
+    # ------------------------------------------------------------- drafting
+
+    @staticmethod
+    def _ngram_draft(hist: list, k: int) -> list:
+        """Prompt-lookup self-drafting (zero dispatches): find the most
+        recent EARLIER occurrence of the trailing bigram (unigram
+        fallback) in the slot's own prompt+output history and propose its
+        historical continuation, padded by repeating the last proposal.
+        Wrong guesses only cost acceptance — verification makes the
+        emitted stream independent of draft quality."""
+        out = []
+        for n in (2, 1):
+            if len(hist) <= n:
+                continue
+            pat = hist[-n:]
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i : i + n] == pat:
+                    out = list(hist[i + n : i + n + k])
+                    break
+            if out:
+                break
+        while len(out) < k:
+            out.append(out[-1] if out else hist[-1])
+        return out[:k]
+
+    def _last_committed_token(self, s: _Slot) -> int:
+        """The token whose K/V sits at position ctx-1 (last FED token):
+        the newest generated-and-fed token, or the prompt's last real
+        token right after prefill."""
+        r = s.request
+        if s.steps_done >= 1:
+            return int(r.tokens[s.steps_done - 1])
+        return int(r.prompt_ids[r.prompt_len - 1])
+
+    def _model_drafts(self, spec_slots) -> np.ndarray:
+        """Draft-model lane: k+1 batched greedy single-token dispatches on
+        the draft model. The FIRST feed re-writes the last committed
+        token at ctx-1 — idempotent K/V resync that heals the one position
+        a fully-accepted previous tick never fed the draft — then the
+        pending token and each proposal feed forward. Only spec slots get
+        real block-table rows; everyone else parks on the null page."""
+        cfg = self.config
+        S, k = cfg.num_slots, cfg.spec_k
+        drafts = np.zeros((S, k), np.int32)
+        toks = np.zeros((S,), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        bt = np.zeros_like(self._pages.block_table)
+        for i in spec_slots:
+            s = self._slots[i]
+            toks[i] = self._last_committed_token(s)
+            ctx[i] = s.request.prompt_len + s.steps_done - 1
+            bt[i] = self._pages.block_table[i]
+        pending = np.zeros((S,), np.int32)
+        inc = np.zeros((S,), np.int32)
+        for i in spec_slots:
+            pending[i] = self._slots[i].pending_token
+            inc[i] = 1
+        fn = self._draft_decode_fn()
+        outs = []
+        with watchdog_guard("serve_decode"):
+            # the autoregressive chain stays ON DEVICE: dispatch j >= 2
+            # feeds dispatch j-1's output array directly (no host sync in
+            # the loop), and the k proposals come back in ONE device_get.
+            # Dispatch 0's output is discarded — it only resyncs the
+            # draft cache at ctx-1; dispatch 1 feeds the pending token.
+            bt_d = jax.device_put(bt)
+            feed = jax.device_put(toks)
+            for j in range(k + 1):
+                out, self._draft_cache = fn(
+                    self._draft_params, self._draft_cache, feed,
+                    bt_d, jax.device_put(ctx),
+                )
+                outs.append(out)
+                feed = jax.device_put(pending) if j == 0 else out
+                ctx = ctx + inc
+            proposals = np.stack(jax.device_get(outs[1:]), axis=1)
+        for i in spec_slots:
+            drafts[i] = proposals[i]
+        return drafts
+
+    # ---------------------------------------------------------- verify tick
+
+    def _verify_tick(self, active) -> None:
+        """ONE verify dispatch advancing every decode-phase slot 1..k+1
+        tokens: draft (host n-gram or draft model), score all k+1
+        positions, accept the leading exact-match run on device, emit the
+        accepted tokens plus the first divergence's stream sample.
+        Non-spec slots ride the same dispatch with their acceptance forced
+        to 0 — they emit exactly the one token the legacy decode step
+        would. Rollback is implicit: the slot's context cursor only
+        advances past what was accepted; rejected drafts' K/V lanes die by
+        masking and are overwritten when their positions are legitimately
+        reached (zero allocator churn, pinned by tests)."""
+        cfg = self.config
+        S, k = cfg.num_slots, cfg.spec_k
+        Q = k + 1
+        spec_slots = [i for i in active if self._slots[i].spec]
+        if self._draft_model is not None and spec_slots:
+            drafts = self._model_drafts(spec_slots)
+        else:
+            drafts = np.zeros((S, k), np.int32)
+            for i in spec_slots:
+                s = self._slots[i]
+                r = s.request
+                hist = [int(t) for t in r.prompt_ids[: r.prompt_len]]
+                hist.extend(int(t) for t in r.tokens)
+                drafts[i] = self._ngram_draft(hist, k)
+        tokens = np.zeros((S, Q), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.int32)
+        steps0 = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        # sanitized block table: mid-prefill slots hold REAL pages but are
+        # not in this dispatch — their rows must read as the null page or
+        # the verify scatter would stomp their streamed prompt K/V
+        bt = np.zeros_like(self._pages.block_table)
+        for i in active:
+            s = self._slots[i]
+            r = s.request
+            tokens[i, 0] = s.pending_token
+            tokens[i, 1:] = drafts[i] if s.spec else s.pending_token
+            ctx[i] = r.prompt_len + s.steps_done
+            seeds[i] = np.int32(r.seed)
+            steps0[i] = s.steps_done + 1   # == len(r.tokens) at sample
+            temps[i] = r.temperature
+            top_ks[i] = min(r.top_k, np.iinfo(np.int32).max)
+            bt[i] = self._pages.block_table[i]
+        ops = jax.device_put(
+            (tokens, bt, ctx, seeds, steps0, temps, top_ks)
+        )
+        with watchdog_guard("serve_decode"):
+            out, self._cache = self._verify_fn()(
+                self._params, self._cache, *ops
+            )
+            # the tick's D2H: per-position stream samples + accept counts
+            target, accept = jax.device_get(out)
+        self.spec_dispatches += 1
+        self.decode_dispatches += 1
+        emitted = 0
+        accepted = 0
+        for i in active:
+            s = self._slots[i]
+            r = s.request
+            a = int(accept[i]) if s.spec else 0
+            if s.spec:
+                self.spec_drafted += k
+                self.spec_accepted += a
+                accepted += a
+            finished = False
+            for j in range(a + 1):
+                token = int(target[i, j])
+                s.steps_done += 1
+                self._emit_token(r, token)
+                emitted += 1
+                if self._is_terminal(r, token):
+                    self._evict(i)
+                    finished = True
+                    break
+            if not finished:
+                s.pending_token = int(target[i, a])
+        self.decode_tokens += emitted
+        if spec_slots:
+            self._registry.gauge(
+                "serve/spec_accept_rate", accepted / (k * len(spec_slots))
+            )
+        self._registry.gauge(
+            "serve/tokens_per_dispatch", emitted / len(active)
+        )
 
     # ------------------------------------------------------------------ tick
 
@@ -1005,15 +1709,22 @@ class DecodeEngine:
         # head blocks the queue — no-bypass backpressure, requests behind
         # it wait for pages to free rather than starving it)
         self._page_blocked = False
+        chunked = self._pages is not None and self.config.prefill_chunk > 0
         while True:
             slot = self._free_slot()
             if slot is None:
                 break
-            req = self._queue.pop_ready(accept=self._admission_fits)
+            req = self._queue.pop_ready(
+                accept=self._admission_fits,
+                defer=self._admission_defer if chunked else None,
+            )
             if req is None:
                 break
             try:
-                self._admit(req, slot)
+                if chunked:
+                    self._admit_chunked(req, slot)
+                else:
+                    self._admit(req, slot)
             except Exception:
                 # the request is already popped and not yet slotted: an
                 # admission failure (guard violation, wedged prefill, OOM)
@@ -1027,8 +1738,21 @@ class DecodeEngine:
             self.page_exhausted += 1
             self._registry.inc("serve/page_exhausted")
 
-        active = [i for i, s in enumerate(self._slots) if s is not None]
-        if active:
+        # streaming prompts advance one chunk each, AFTER admissions (a
+        # just-admitted slot gets its first chunk this very tick) and
+        # BEFORE decode (its pages must be committed before the verify
+        # scatter could reach them)
+        if chunked:
+            worked = self._advance_prefills() or worked
+
+        active = [
+            i for i, s in enumerate(self._slots)
+            if s is not None and s.phase == "decode"
+        ]
+        if active and self._pages is not None and self.config.spec_k > 0:
+            self._verify_tick(active)
+            worked = True
+        elif active:
             S = self.config.num_slots
             tokens = np.zeros((S,), np.int32)
             mask = np.zeros((S,), bool)
@@ -1049,9 +1773,16 @@ class DecodeEngine:
                 top_ks[i] = min(r.top_k, np.iinfo(np.int32).max)
             sample_ops = (seeds, steps, temps, top_ks)
             if self._pages is not None:
-                ops = jax.device_put(
-                    (tokens, self._pages.block_table, ctx) + sample_ops
-                )
+                if chunked:
+                    # mid-prefill slots hold real pages but are not in
+                    # this dispatch — null their rows so the decode
+                    # scatter can't stomp a streaming prompt's K/V
+                    bt = np.zeros_like(self._pages.block_table)
+                    for i in active:
+                        bt[i] = self._pages.block_table[i]
+                else:
+                    bt = self._pages.block_table
+                ops = jax.device_put((tokens, bt, ctx) + sample_ops)
             else:
                 ops = jax.device_put((tokens, mask) + sample_ops)
             with watchdog_guard("serve_decode"):
@@ -1078,6 +1809,9 @@ class DecodeEngine:
                     self._evict(i)          # slot + pages free for reuse
                 else:
                     s.pending_token = token
+            self.decode_dispatches += 1
+            self.decode_tokens += len(active)
+            self._registry.gauge("serve/tokens_per_dispatch", 1.0)
             worked = True
 
         self.ticks += 1
@@ -1142,6 +1876,23 @@ class DecodeEngine:
             "kv_pages_free": self._pages.pages_free if paged else None,
             "kv_pages_peak": self._pages.peak_used if paged else None,
             "page_exhausted": self.page_exhausted,
+            "spec_k": self.config.spec_k,
+            "spec_draft": (
+                self.config.spec_draft if self.config.spec_k > 0 else None
+            ),
+            "spec_dispatches": self.spec_dispatches,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": (
+                self.spec_accepted / self.spec_drafted
+                if self.spec_drafted else None
+            ),
+            "tokens_per_dispatch": (
+                self.decode_tokens / self.decode_dispatches
+                if self.decode_dispatches else None
+            ),
+            "prefill_chunk": self.config.prefill_chunk,
+            "prefill_chunks": self.prefill_chunks,
             "weights_step": self.weights_step,
             "swaps": self.swaps,
             "swap_rollbacks": self.swap_rollbacks,

@@ -75,6 +75,22 @@ class PageAllocator:
         """Pages covering ``total_tokens`` (prompt + worst-case new)."""
         return -(-max(total_tokens, 1) // self.page_size)
 
+    def pages_reserved(self, total_tokens: int, spec_k: int = 0) -> int:
+        """Admission reservation WITH speculative overshoot.
+
+        The reservation formula (pinned by tests/test_spec.py): a spec slot
+        reserves ``pages_needed(total_tokens + spec_k)``. Why ``+ spec_k``:
+        a verify tick launched one token before the emission cap writes its
+        pending token plus k drafts before acceptance is known, so the
+        highest position ever SCATTERED is ``(prompt + max_new - 2) + k``
+        — i.e. ``total_tokens + spec_k - 1`` last-index, exactly covered.
+        Rejected drafts stay in those over-reserved pages as dead lanes
+        (masked by ``context_len``, overwritten on reuse): rollback is a
+        host-side cursor rewind with zero allocator churn, and
+        ``page_exhausted`` can never fire mid-flight for an admitted slot.
+        """
+        return self.pages_needed(total_tokens + max(spec_k, 0))
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 

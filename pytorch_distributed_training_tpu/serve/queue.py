@@ -78,6 +78,11 @@ class GenRequest:
     deadline_s: Optional[float] = None      # relative to submit
     stream: Optional[Callable] = None       # stream(req, token_id) per token
     on_finish: Optional[Callable] = None    # on_finish(req) at terminal state
+    # Speculative decoding opt-in/out for this request; None defers to the
+    # engine default (EngineConfig.spec_k > 0). Identity is unconditional —
+    # spec and non-spec slots emit the same stream — so this is a latency
+    # knob, not a quality one.
+    spec: Optional[bool] = None
 
     # ---- engine-owned runtime state
     status: str = "new"      # new -> queued -> running -> done|expired|cancelled
@@ -203,9 +208,18 @@ class RequestQueue:
                 dq.extend(keep)
         return expired
 
-    def pop_ready(self, accept=None) -> Optional[GenRequest]:
+    def pop_ready(self, accept=None, defer=None) -> Optional[GenRequest]:
         """FIFO-within-bucket pop: the earliest-submitted request among the
         bucket heads, or None when idle.
+
+        ``defer`` (optional) is a TRANSIENT hold predicate checked before
+        ``accept``: when it returns True for the head, the pop returns None
+        with no side effects at all — the head stays put and no failure is
+        implied. The engine uses it for chunked-prefill residency: while a
+        resident slot is still streaming its prompt in, further admissions
+        wait a tick WITHOUT being counted as page exhaustion (the mid-
+        prefill slot must not be starved of ticks by a burst of admissions,
+        and the hold must not inflate ``serve/page_exhausted``).
 
         ``accept`` (optional) is an admission predicate on the candidate
         head — the engine's page-budget check. When the scheduler-order
@@ -218,6 +232,8 @@ class RequestQueue:
                 if dq and (head is None or dq[0].submit_t < head[0].submit_t):
                     head = dq
             if head is None:
+                return None
+            if defer is not None and defer(head[0]):
                 return None
             if accept is not None and not accept(head[0]):
                 return None

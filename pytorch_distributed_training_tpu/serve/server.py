@@ -72,6 +72,8 @@ class InferenceServer:
         guards=None,
         stall_timeout_s: float = 10.0,
         weights_step: Optional[int] = None,
+        draft_model=None,
+        draft_params=None,
     ):
         self.queue = RequestQueue(
             max_depth=queue_depth,
@@ -81,6 +83,7 @@ class InferenceServer:
         self.engine = DecodeEngine(
             model, params, config, self.queue, registry=registry,
             guards=guards, weights_step=weights_step,
+            draft_model=draft_model, draft_params=draft_params,
         )
         self.default_deadline_s = default_deadline_s
         self.stall_timeout_s = stall_timeout_s
@@ -181,6 +184,7 @@ class InferenceServer:
         stream=None,
         on_finish=None,
         request_id: Optional[str] = None,
+        spec: Optional[bool] = None,
     ) -> GenRequest:
         """Enqueue one request (any thread). Raises ``BackpressureError``
         when the queue is full; the request's ``done`` event fires at every
@@ -198,6 +202,7 @@ class InferenceServer:
             ),
             stream=stream,
             on_finish=on_finish,
+            spec=spec,
         )
         return self.queue.submit(req)
 

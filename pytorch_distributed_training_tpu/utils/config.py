@@ -156,6 +156,15 @@ class ModelConfig:
     # "reference" = XLA gather+einsum (bitwise-pinned against the dense
     # cache path); "pallas" = the online-softmax page-walk kernel.
     paged_attention_impl: str = "reference"
+    # Multi-token-query paged decode (speculative verify / chunked prefill):
+    # a chunk of new tokens is scattered into the pages and then attends
+    # causally over the WHOLE context (prior pages + itself) through the
+    # 4-D-query paged_attention path, instead of the fresh-sequence
+    # intra-chunk einsum. Only read when decode=True and kv_layout="paged";
+    # the serving engine builds a second model view with this set rather
+    # than flipping it on the decode model (chunk==1 decode keeps the
+    # single-query program and its bitwise pins).
+    paged_multiquery: bool = False
     # RoBERTa-style embeddings (pad-offset position ids, no token types)
     roberta_style: bool = False
     pad_token_id: int = 0

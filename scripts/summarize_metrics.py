@@ -296,6 +296,33 @@ def summarize_paged(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_spec(records: list[dict]) -> dict | None:
+    """Fold the engine's speculative-decoding counters (final
+    ``serve_summary``) into the speculation view: draft mode, dispatch and
+    acceptance counts, and the two ratios that tell whether speculation
+    paid for itself — acceptance rate (drafted tokens that matched the
+    target stream) and tokens/dispatch (committed tokens per device
+    round-trip; 1.0 is the non-speculative floor). None when the stream
+    predates speculation or the engine ran with it off."""
+    summaries = [r for r in records if r.get("record") == "serve_summary"]
+    if not summaries:
+        return None
+    last = summaries[-1]
+    if not last.get("spec_k"):
+        return None
+    return {
+        "spec_k": last.get("spec_k"),
+        "spec_draft": last.get("spec_draft"),
+        "dispatches": last.get("spec_dispatches"),
+        "drafted": last.get("spec_drafted"),
+        "accepted": last.get("spec_accepted"),
+        "accept_rate": last.get("spec_accept_rate"),
+        "tokens_per_dispatch": last.get("tokens_per_dispatch"),
+        "prefill_chunk": last.get("prefill_chunk"),
+        "prefill_chunks": last.get("prefill_chunks"),
+    }
+
+
 def summarize_serve(records: list[dict]) -> dict | None:
     """Fold ``serve_request`` records into per-bucket latency percentiles
     plus aggregate serving stats; None when the stream holds none."""
@@ -338,6 +365,7 @@ def summarize_serve(records: list[dict]) -> dict | None:
         "tpot_s": _pcts([r.get("tpot_s") for r in done]),
         "buckets": buckets,
         "paged": summarize_paged(records),
+        "spec": summarize_spec(records),
     }
 
 
@@ -499,6 +527,23 @@ def render_serve_table(serve: dict) -> str:
             lines.append(
                 f"kv-cache: dense  sampling={paged.get('sampling')}"
             )
+    spec = serve.get("spec")
+    if spec:
+        line = (
+            f"speculation: k={_fmt(spec.get('spec_k'))} "
+            f"draft={spec.get('spec_draft')} "
+            f"accept-rate={_fmt(spec.get('accept_rate'), '.3f')} "
+            f"tokens/dispatch={_fmt(spec.get('tokens_per_dispatch'), '.2f')} "
+            f"(dispatches={_fmt(spec.get('dispatches'))} "
+            f"drafted={_fmt(spec.get('drafted'))} "
+            f"accepted={_fmt(spec.get('accepted'))})"
+        )
+        if spec.get("prefill_chunk"):
+            line += (
+                f" prefill-chunk={_fmt(spec.get('prefill_chunk'))}"
+                f" chunks={_fmt(spec.get('prefill_chunks'))}"
+            )
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -291,9 +291,11 @@ def test_paged_pallas_interpret_matches_reference():
 
 def test_paged_attention_validates_shapes():
     q, k, v, k_pages, v_pages, bt, lengths = _paged_fixture()
+    # q may be [B, H, D] (single query) or [B, Q, H, D] (multi-token
+    # query, the spec-verify / chunked-prefill path) — 5-D is invalid.
     with pytest.raises(ValueError):
         paged_attention(
-            jnp.asarray(q)[:, None], jnp.asarray(k_pages),
+            jnp.asarray(q)[:, None, None], jnp.asarray(k_pages),
             jnp.asarray(v_pages), jnp.asarray(bt), jnp.asarray(lengths),
             scale=1.0,
         )
