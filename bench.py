@@ -1211,6 +1211,443 @@ def run_fleet(
     return result
 
 
+# --------------------------------------------------------------- storm mode
+# Overload-survival drill on CPU: a seeded OPEN-LOOP trace (Poisson base +
+# one burst episode, heavy-tailed sizes, SLO tiers — serve/trace.py) is
+# replayed against a 2-replica autoscaled fleet while replica 0 is
+# SIGKILLed mid-burst. Unlike the closed-loop benches the offered load
+# does not self-throttle, so the burst genuinely queues and the brownout
+# ladder + autoscaler actually fire. Gates: interactive availability
+# >= 0.99 (honest retries allowed — clients honor the Retry-After the
+# server computes), zero hung waiters, >= 1 scale-up AND >= 1 drain-based
+# scale-down with measured latencies, every shed explicit (429/503 +
+# Retry-After), and every accepted stream token-identical to an unloaded
+# greedy reference pass. Runs in a JAX_PLATFORMS=cpu subprocess.
+
+
+def _storm_prompt(prompt_len: int) -> str:
+    """Deterministic prompt of exactly prompt_len tokens under the serve
+    CLI's raw-byte fallback tokenizer (one token per byte), identical
+    across the reference and storm passes so greedy streams are
+    comparable."""
+    return "".join(str((prompt_len + j) % 10) for j in range(prompt_len))
+
+
+def _storm_child(cfg_json: str) -> None:
+    import http.client
+    import math
+    import threading
+
+    from pytorch_distributed_training_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        Autoscaler,
+    )
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import (
+        RouterConfig,
+        make_router_http_server,
+    )
+    from pytorch_distributed_training_tpu.serve.trace import (
+        TraceConfig,
+        generate_trace,
+        replay,
+        trace_stats,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+
+    cfg = json.loads(cfg_json)
+    burst_start = cfg["burst_start_s"]
+    burst_dur = cfg["burst_dur_s"]
+    trace_cfg = TraceConfig(
+        seed=cfg["seed"],
+        duration_s=cfg["duration_s"],
+        base_rate_rps=cfg["base_rps"],
+        burst_rate_rps=cfg["burst_rps"],
+        bursts=((burst_start, burst_dur),),
+        interactive_fraction=0.7,
+        # sizes chosen to fit the replicas' 16/32 prompt buckets and keep
+        # the CPU run inside the bench budget while still heavy-tailed
+        prompt_len_median=8.0, prompt_len_sigma=0.5,
+        prompt_len_min=2, prompt_len_max=24,
+        output_tokens_median=10.0, output_tokens_sigma=0.8,
+        output_tokens_min=2, output_tokens_max=32,
+        interactive_deadline_s=60.0, batch_deadline_s=120.0,
+    )
+    events = generate_trace(trace_cfg)
+
+    registry = MetricsRegistry()
+    sink = _ListSink()
+    registry.attach_sink(sink)
+
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "4",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "64",
+                "--queue-depth", "24",
+                "--interactive-deadline-s", "60",
+                "--batch-deadline-s", "120",
+                "--brownout-high", "0.75", "--brownout-low", "0.25",
+                "--brownout-clamp", "8",
+            ),
+            max_restarts=2,
+            backoff_s=0.2,
+            drain_timeout_s=20.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, breaker_threshold=3,
+            breaker_cooldown_s=0.5, retry_backoff_s=0.02,
+            retry_backoff_max_s=0.1, ttfb_timeout_s=120.0,
+        ),
+        registry=registry,
+    ).start()
+    assert fleet.wait_ready(timeout=180), fleet.stats()
+    httpd = make_router_http_server(fleet.router)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    autoscaler = Autoscaler(
+        fleet,
+        AutoscaleConfig(
+            min_replicas=1, max_replicas=3,
+            scale_up_queue_depth=3.0, scale_down_queue_depth=0.5,
+            page_occupancy_high=0.85,
+            up_hold_s=0.4, down_hold_s=1.5,
+            up_cooldown_s=3.0, down_cooldown_s=3.0,
+            poll_interval_s=0.2,
+        ),
+        registry=registry,
+    )
+
+    def one_request(rid: str, prompt_len: int, max_new: int,
+                    tier: str) -> dict:
+        """One POST /generate through the router. Outcomes: ``done``
+        (stream completed; ``tokens`` carries the greedy ids), ``shed``
+        (explicit 4xx/5xx answer; records whether it was HONEST — allowed
+        status + Retry-After header), ``retryable_error`` (stream started
+        then died retryably, e.g. the SIGKILLed replica) or
+        ``exception``."""
+        t0 = time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps({
+                    "prompt": _storm_prompt(prompt_len),
+                    "max_new_tokens": max_new,
+                    "tier": tier,
+                }),
+                headers={"X-Request-Id": rid},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                retry_after = resp.getheader("Retry-After")
+                resp.read()
+                conn.close()
+                return {
+                    "outcome": "shed",
+                    "status": resp.status,
+                    "honest": (
+                        resp.status in (429, 503)
+                        and retry_after is not None
+                    ),
+                    "retry_after_s": float(retry_after or 1.0),
+                    "latency_s": time.perf_counter() - t0,
+                }
+            lines = resp.read().decode().splitlines()
+            conn.close()
+            parsed = [json.loads(ln) for ln in lines if ln.strip()]
+            last = parsed[-1] if parsed else {}
+            if last.get("event") == "done":
+                return {
+                    "outcome": "done",
+                    "tokens": [
+                        ev["token_id"] for ev in parsed
+                        if ev.get("event") == "token"
+                    ],
+                    "latency_s": time.perf_counter() - t0,
+                }
+            if last.get("event") == "error" and last.get("retryable"):
+                return {"outcome": "retryable_error",
+                        "latency_s": time.perf_counter() - t0}
+            return {"outcome": "bad", "last": last,
+                    "latency_s": time.perf_counter() - t0}
+        except Exception as e:
+            return {"outcome": "exception", "error": repr(e),
+                    "latency_s": time.perf_counter() - t0}
+
+    # ---- unloaded reference pass: one greedy stream per distinct prompt
+    # length at the full output cap; the storm's accepted streams must be
+    # exact prefixes of these (greedy + identical weights across replicas).
+    # Doubles as the compile-cache warmup for both prompt buckets.
+    ref_max_new = {}
+    for ev in events:
+        ref_max_new[ev.prompt_len] = max(
+            ref_max_new.get(ev.prompt_len, 0), ev.max_new_tokens
+        )
+    reference = {}
+    for plen, max_new in sorted(ref_max_new.items()):
+        out = one_request(f"ref-{plen}", plen, max_new, "interactive")
+        if out["outcome"] != "done":
+            raise RuntimeError(f"reference pass failed for len={plen}: {out}")
+        reference[plen] = out["tokens"]
+
+    # ---- the storm: open-loop replay + mid-burst SIGKILL + autoscaler
+    autoscaler.start()
+    results: list = [None] * len(events)
+    threads: list = []
+    kill_at_s = burst_start + cfg["kill_offset_s"]
+    kill_info = {"fired_t_s": None}
+
+    def client(ev) -> None:
+        t0 = time.perf_counter()
+        attempts = []
+        # interactive clients retry honest retryable answers (honoring the
+        # server's Retry-After, capped so the bench terminates); batch
+        # traffic takes its shed and leaves — exactly the SLO contract
+        budget = 8 if ev.tier == "interactive" else 1
+        for attempt in range(budget):
+            out = one_request(
+                f"storm-{ev.index}-{attempt}", ev.prompt_len,
+                ev.max_new_tokens, ev.tier,
+            )
+            attempts.append(out)
+            if out["outcome"] == "done" or (
+                out["outcome"] == "shed" and not out["honest"]
+            ):
+                break
+            if attempt + 1 < budget:
+                time.sleep(min(out.get("retry_after_s", 0.5), 4.0))
+        results[ev.index] = {
+            "tier": ev.tier,
+            "prompt_len": ev.prompt_len,
+            "burst": ev.burst,
+            "attempts": attempts,
+            "final": attempts[-1]["outcome"],
+            "tokens": attempts[-1].get("tokens"),
+            "total_s": time.perf_counter() - t0,
+        }
+
+    def killer() -> None:
+        time.sleep(kill_at_s)
+        kill_info["fired_t_s"] = kill_at_s
+        fleet.replica(0).kill()     # hard SIGKILL mid-burst
+
+    threading.Thread(target=killer, daemon=True).start()
+
+    def fire(ev) -> None:
+        t = threading.Thread(target=client, args=(ev,), daemon=True)
+        t.start()
+        threads.append(t)
+
+    replayed = replay(events, fire)
+
+    hung = 0
+    for t in threads:
+        t.join(_BENCH_WAIT_S)
+        if t.is_alive():
+            hung += 1
+    hung += sum(1 for r in results if r is None)
+
+    # ---- quiet tail: the pool drains, the autoscaler's idle signal holds
+    # and retires the storm capacity through the graceful exit-75 path
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        st = autoscaler.stats()
+        down_done = any(
+            r.get("record") == "fleet_scale" and r.get("action") == "down"
+            and r.get("drain_s") is not None
+            for r in sink.records
+        )
+        up_ready = st["scale_ups"] == 0 or any(
+            r.get("record") == "autoscale_ready" for r in sink.records
+        )
+        if st["scale_downs"] >= 1 and down_done and up_ready:
+            break
+        time.sleep(0.25)
+
+    # recovery: brownout must fall back to level 0 on every live replica
+    brownout_zero = False
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        views = [r for r in fleet.router.replicas if r.available()]
+        if views and all(
+            int(v.health.get("brownout_level", 0)) == 0 for v in views
+        ):
+            brownout_zero = True
+            break
+        time.sleep(0.25)
+    post = one_request("post-recovery", 8, 16, "interactive")
+
+    auto_stats = autoscaler.stats()
+    fleet_stats = fleet.stats()
+    autoscaler.close()
+    httpd.shutdown()
+    fleet.stop(drain=False)
+
+    # ---- gates
+    def pct(lat: list, p: float):
+        lat = sorted(lat)
+        return (
+            round(lat[min(len(lat) - 1, math.ceil(p / 100 * len(lat)) - 1)],
+                  4)
+            if lat else None
+        )
+
+    def tier_summary(tier: str) -> dict:
+        rows = [r for r in results if r is not None and r["tier"] == tier]
+        done = [r for r in rows if r["final"] == "done"]
+        shed = [r for r in rows if r["final"] == "shed"]
+        lat = [r["total_s"] for r in done]
+        return {
+            "requests": len(rows),
+            "done": len(done),
+            "shed": len(shed),
+            "other": len(rows) - len(done) - len(shed),
+            "availability": (
+                round(len(done) / len(rows), 4) if rows else None
+            ),
+            "p50_s": pct(lat, 50),
+            "p95_s": pct(lat, 95),
+            "p99_s": pct(lat, 99),
+        }
+
+    sheds = [
+        a for r in results if r is not None
+        for a in r["attempts"] if a["outcome"] == "shed"
+    ]
+    dishonest_sheds = sum(1 for s in sheds if not s["honest"])
+
+    mismatches = []
+    checked = 0
+    for r in results:
+        if r is None or r["final"] != "done":
+            continue
+        checked += 1
+        ref = reference[r["prompt_len"]]
+        got = r["tokens"]
+        if len(got) > len(ref) or got != ref[:len(got)]:
+            mismatches.append({
+                "prompt_len": r["prompt_len"],
+                "got": got[:8], "ref": ref[:8],
+            })
+
+    ready_s = [
+        r["ready_s"] for r in sink.records
+        if r.get("record") == "autoscale_ready"
+    ]
+    drain_s = [
+        r["drain_s"] for r in sink.records
+        if r.get("record") == "fleet_scale" and r.get("action") == "down"
+        and r.get("drain_s") is not None
+    ]
+
+    interactive = tier_summary("interactive")
+    batch = tier_summary("batch")
+    gates = {
+        "interactive_availability_ok": (
+            interactive["availability"] is not None
+            and interactive["availability"] >= 0.99
+        ),
+        "zero_hung_waiters": hung == 0,
+        "scale_up_recorded": auto_stats["scale_ups"] >= 1 and bool(ready_s),
+        "scale_down_recorded": (
+            auto_stats["scale_downs"] >= 1 and bool(drain_s)
+        ),
+        "sheds_all_explicit": dishonest_sheds == 0,
+        "token_identity_ok": not mismatches,
+        "recovered": brownout_zero and post["outcome"] == "done",
+    }
+    result = {
+        "metric": (
+            f"storm bench (tiny LM, CPU, seeded open-loop replay: "
+            f"{len(events)} requests over {trace_cfg.duration_s:.0f}s, "
+            f"burst {cfg['burst_rps']}rps@{burst_start:.0f}s, replica 0 "
+            f"SIGKILLed mid-burst, autoscaled 2->3->drain)"
+        ),
+        "trace": {"seed": trace_cfg.seed, **trace_stats(events)},
+        "replay": replayed,
+        "interactive": interactive,
+        "batch": batch,
+        "sheds": {
+            "total": len(sheds),
+            "dishonest": dishonest_sheds,
+            "by_status": {
+                str(s): sum(1 for x in sheds if x["status"] == s)
+                for s in sorted({x["status"] for x in sheds})
+            },
+        },
+        "hung_waiters": hung,
+        "token_identity": {
+            "streams_checked": checked,
+            "mismatches": mismatches[:5],
+        },
+        "autoscale": {
+            "scale_ups": auto_stats["scale_ups"],
+            "scale_downs": auto_stats["scale_downs"],
+            "scale_up_ready_s": [round(s, 3) for s in ready_s],
+            "scale_down_drain_s": [round(s, 3) for s in drain_s],
+        },
+        "kill": {
+            "replica": "r0",
+            "at_s": kill_info["fired_t_s"],
+            "restarts_used": next(
+                (r["restarts_used"] for r in fleet_stats["replicas"]
+                 if r["replica"] == "r0"), None,
+            ),
+        },
+        "recovery": {
+            "brownout_returned_to_zero": brownout_zero,
+            "post_storm_request": post["outcome"],
+        },
+        "pool": fleet_stats["pool"],
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    print(json.dumps(result))
+
+
+def run_storm(
+    seed: int = 0,
+    duration_s: float = 14.0,
+    base_rps: float = 2.0,
+    burst_rps: float = 10.0,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PDT_TPU_FAULT", None)      # the bench kills by pid, not spec
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+    cfg = dict(
+        seed=seed, duration_s=duration_s, base_rps=base_rps,
+        burst_rps=burst_rps, burst_start_s=4.0,
+        burst_dur_s=max(2.0, duration_s / 4), kill_offset_s=1.0,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--storm-child", json.dumps(cfg)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"storm bench failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 # ---------------------------------------------------------------- swap mode
 # Latency-under-rollout drill on CPU: a 2-replica fleet serves a closed
 # loop while a NEW checkpoint step is published mid-load and rolled across
@@ -1753,6 +2190,23 @@ def main(argv=None):
     p.add_argument("--fleet-out", default="BENCH_fleet.json",
                    help="where --fleet writes its JSON")
     p.add_argument("--fleet-child", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--storm", action="store_true",
+                   help="overload-survival bench on CPU: a seeded open-"
+                        "loop trace (Poisson base + burst, SLO tiers) "
+                        "replayed against an autoscaled fleet with one "
+                        "replica SIGKILLed mid-burst; gates interactive "
+                        "availability, explicit sheds, scale-up/down "
+                        "latencies and token identity vs an unloaded run "
+                        "(no TPU, no probe)")
+    p.add_argument("--storm-seed", type=int, default=0,
+                   help="trace seed (same seed -> identical storm)")
+    p.add_argument("--storm-duration-s", type=float, default=14.0)
+    p.add_argument("--storm-base-rps", type=float, default=2.0)
+    p.add_argument("--storm-burst-rps", type=float, default=10.0,
+                   help="arrival rate inside the burst episode")
+    p.add_argument("--storm-out", default="BENCH_storm.json",
+                   help="where --storm writes its JSON")
+    p.add_argument("--storm-child", default=None, help=argparse.SUPPRESS)
     p.add_argument("--swap", action="store_true",
                    help="hot-swap rollout bench on CPU: 2 replicas behind "
                         "the router, a new checkpoint step published and "
@@ -1816,6 +2270,19 @@ def main(argv=None):
     if args.swap_child:
         _swap_child(args.swap_child)
         return {"swap_child": True}
+    if args.storm_child:
+        _storm_child(args.storm_child)
+        return {"storm_child": True}
+    if args.storm:
+        result = run_storm(
+            seed=args.storm_seed,
+            duration_s=args.storm_duration_s,
+            base_rps=args.storm_base_rps,
+            burst_rps=args.storm_burst_rps,
+            out_path=args.storm_out,
+        )
+        print(json.dumps(result))
+        return result
     if args.swap:
         result = run_swap(
             requests=args.swap_requests,

@@ -98,6 +98,44 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("size", "digest"),
                    help="integrity level a step must pass before the "
                         "rolling swap admits it")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="enable queue-driven autoscaling up to this pool "
+                        "size (0 = static pool); scale-up spawns through "
+                        "the normal machinery, scale-down drains via "
+                        "SIGTERM/exit-75 so no in-flight request dies")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscaler floor (never drains below this)")
+    p.add_argument("--autoscale-up-depth", type=float, default=6.0,
+                   help="scale up when mean queue depth per available "
+                        "replica holds at/above this")
+    p.add_argument("--autoscale-down-depth", type=float, default=1.0,
+                   help="scale down when mean queue depth per available "
+                        "replica holds at/below this")
+    p.add_argument("--autoscale-up-hold-s", type=float, default=1.0,
+                   help="scale-up signal must persist this long")
+    p.add_argument("--autoscale-down-hold-s", type=float, default=5.0,
+                   help="idle signal must persist this long before "
+                        "retiring capacity")
+    p.add_argument("--autoscale-up-cooldown-s", type=float, default=5.0,
+                   help="no further scaling for this long after a "
+                        "scale-up")
+    p.add_argument("--autoscale-down-cooldown-s", type=float, default=10.0,
+                   help="no further scaling for this long after a "
+                        "scale-down")
+    p.add_argument("--autoscale-poll-s", type=float, default=0.5,
+                   help="autoscaler evaluation cadence")
+    p.add_argument("--interactive-deadline-s", type=float, default=0.0,
+                   help="per-tier SLO deadline forwarded to every replica")
+    p.add_argument("--batch-deadline-s", type=float, default=0.0,
+                   help="per-tier SLO deadline forwarded to every replica")
+    p.add_argument("--brownout-high", type=float, default=0.0,
+                   help="forward the brownout ladder to every replica: "
+                        "escalate when queue pressure holds above this "
+                        "fraction (0 = off; see serve_lm)")
+    p.add_argument("--brownout-low", type=float, default=0.3,
+                   help="brownout de-escalation watermark (see serve_lm)")
+    p.add_argument("--brownout-clamp", type=int, default=16,
+                   help="brownout level-2 max_new_tokens cap (see serve_lm)")
     return p
 
 
@@ -163,16 +201,30 @@ def main(argv=None) -> dict:
     ]
     if args.lock_summary_s > 0:
         replica_args += ["--lock-summary-s", str(args.lock_summary_s)]
+    if args.interactive_deadline_s > 0:
+        replica_args += [
+            "--interactive-deadline-s", str(args.interactive_deadline_s),
+        ]
+    if args.batch_deadline_s > 0:
+        replica_args += ["--batch-deadline-s", str(args.batch_deadline_s)]
+    if args.brownout_high > 0:
+        replica_args += [
+            "--brownout-high", str(args.brownout_high),
+            "--brownout-low", str(args.brownout_low),
+            "--brownout-clamp", str(args.brownout_clamp),
+        ]
     for flag in ("checkpoint_dir", "hf_checkpoint", "vocab", "merges"):
         value = getattr(args, flag)
         if value:
             replica_args += ["--" + flag.replace("_", "-"), value]
     extra_args = {}
     if args.metrics_dir:
-        # per-replica streams: a restarted replica appends to its own file
+        # per-replica streams: a restarted replica appends to its own
+        # file; pre-assign dirs up to the autoscaler's ceiling so scaled-
+        # up replicas stream too
         extra_args = {
             i: ("--metrics-dir", f"{args.metrics_dir}/replica-{i}")
-            for i in range(args.replicas)
+            for i in range(max(args.replicas, args.max_replicas))
         }
 
     fleet = ServeFleet(
@@ -200,6 +252,28 @@ def main(argv=None) -> dict:
             poll_interval_s=args.hotswap_poll_s,
             verify_level=args.hotswap_verify,
         )
+    autoscaler = None
+    if args.max_replicas > 0:
+        from pytorch_distributed_training_tpu.serve.autoscale import (
+            AutoscaleConfig,
+            Autoscaler,
+        )
+
+        autoscaler = Autoscaler(
+            fleet,
+            AutoscaleConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=max(args.max_replicas, args.replicas),
+                scale_up_queue_depth=args.autoscale_up_depth,
+                scale_down_queue_depth=args.autoscale_down_depth,
+                up_hold_s=args.autoscale_up_hold_s,
+                down_hold_s=args.autoscale_down_hold_s,
+                up_cooldown_s=args.autoscale_up_cooldown_s,
+                down_cooldown_s=args.autoscale_down_cooldown_s,
+                poll_interval_s=args.autoscale_poll_s,
+            ),
+            registry=registry,
+        ).start()
     httpd = make_router_http_server(fleet.router, port=args.router_port)
     log0(
         f"fleet router on http://127.0.0.1:{httpd.server_address[1]} "
@@ -234,8 +308,12 @@ def main(argv=None) -> dict:
         log0("draining fleet")
         if lock_summary is not None:
             lock_summary.stop()
+        if autoscaler is not None:
+            autoscaler.close()
         fleet.stop(drain=True)
         stats = fleet.stats()
+        if autoscaler is not None:
+            stats["autoscale"] = autoscaler.stats()
         if sink is not None:
             sink.emit({"record": "fleet_summary", **stats})
             # the fleet process' own lock accounting (router/breaker/

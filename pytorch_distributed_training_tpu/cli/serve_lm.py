@@ -107,6 +107,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-s", type=float, default=0.0,
                    help="default per-request deadline (0 = none); queued "
                         "requests past it expire unserved")
+    p.add_argument("--interactive-deadline-s", type=float, default=0.0,
+                   help="SLO deadline for tier=interactive requests "
+                        "(0 = fall back to --deadline-s)")
+    p.add_argument("--batch-deadline-s", type=float, default=0.0,
+                   help="SLO deadline for tier=batch requests "
+                        "(0 = fall back to --deadline-s)")
+    p.add_argument("--brownout-high", type=float, default=0.0,
+                   help="enable the brownout ladder: escalate one level "
+                        "(shed batch -> clamp max_new -> fail-fast "
+                        "interactive) when queue pressure stays above this "
+                        "fraction of capacity (0 = brownout off)")
+    p.add_argument("--brownout-low", type=float, default=0.3,
+                   help="de-escalate one level when pressure stays below "
+                        "this fraction (hysteresis band with "
+                        "--brownout-high)")
+    p.add_argument("--brownout-clamp", type=int, default=16,
+                   help="max_new_tokens cap applied at brownout level 2+")
+    p.add_argument("--brownout-escalate-hold-s", type=float, default=0.5,
+                   help="pressure must stay above --brownout-high this long "
+                        "before each escalation")
+    p.add_argument("--brownout-deescalate-hold-s", type=float, default=1.0,
+                   help="pressure must stay below --brownout-low this long "
+                        "before each recovery step")
     p.add_argument("--http-port", type=int, default=0,
                    help="serve HTTP on 127.0.0.1:<port> (0 = stdin/JSONL "
                         "mode)")
@@ -237,10 +260,32 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
     guard_mode = args.guards or guard_mode_from_env(default="strict")
     get_lock_registry().mode = guard_mode
 
+    brownout = None
+    if args.brownout_high > 0:
+        from pytorch_distributed_training_tpu.serve.queue import (
+            BrownoutController,
+        )
+
+        brownout = BrownoutController(
+            high_watermark=args.brownout_high,
+            low_watermark=args.brownout_low,
+            escalate_hold_s=args.brownout_escalate_hold_s,
+            deescalate_hold_s=args.brownout_deescalate_hold_s,
+            clamp_max_new=args.brownout_clamp,
+            registry=registry,
+        )
+    tier_deadlines = {}
+    if args.interactive_deadline_s > 0:
+        tier_deadlines["interactive"] = args.interactive_deadline_s
+    if args.batch_deadline_s > 0:
+        tier_deadlines["batch"] = args.batch_deadline_s
+
     server = InferenceServer(
         model, params, config,
         queue_depth=args.queue_depth,
         default_deadline_s=args.deadline_s or None,
+        tier_deadlines=tier_deadlines or None,
+        brownout=brownout,
         registry=registry,
         guards=GuardSet(mode=guard_mode, registry=registry),
         stall_timeout_s=args.stall_timeout_s,
@@ -288,9 +333,29 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             import threading
             import time as _time
 
-            httpd = make_http_server(
-                server, tok, host=args.http_host, port=args.http_port
-            )
+            try:
+                httpd = make_http_server(
+                    server, tok, host=args.http_host, port=args.http_port
+                )
+            except OSError as e:
+                import errno
+
+                if e.errno != errno.EADDRINUSE:
+                    raise
+                # the supervisor's free-port probe is TOCTOU by nature;
+                # losing the bind race is not a crash. Exit 76 so the
+                # fleet retries this replica on a fresh port without
+                # burning a restart from its budget.
+                from pytorch_distributed_training_tpu.serve.fleet import (
+                    PORT_IN_USE_EXIT_CODE,
+                )
+
+                log0(
+                    f"port {args.http_port} already in use; exiting "
+                    f"{PORT_IN_USE_EXIT_CODE} for a fresh-port respawn"
+                )
+                server.close(drain=False)
+                sys.exit(PORT_IN_USE_EXIT_CODE)
             log0(
                 f"serving on http://{args.http_host}:"
                 f"{httpd.server_address[1]} "

@@ -47,6 +47,11 @@ the request stream):
                           slow replica) — drives deadline expiry and the
                           router's load-away-from-slow behavior.
 
+The ``replica_*`` kinds also accept a time trigger — ``replica_crash:t3.5``
+fires on the first busy tick at/after 3.5s from plan arm — for drills
+where the busy-tick count is load-dependent (a replayed storm killing a
+replica mid-burst lands the kill by wall clock, not by tick).
+
 Swap-scoped kinds (fired from the hot-swap loader, serve/hotswap.py, when
 it loads the named CHECKPOINT STEP for a live weight swap — the argument
 is a checkpoint step, not a tick):
@@ -110,6 +115,7 @@ class InjectedCrash(RuntimeError):
 class FaultSpec:
     kind: str
     step: int = 0          # *_at_step kinds
+    at_s: float = 0.0      # replica_* kinds with a t<seconds> trigger
     target: str = ""       # corrupt_ckpt: "latest" or a step number
     factor: float = 1.0    # slow_host
     rank: int = 0          # process index that fires
@@ -134,15 +140,29 @@ def _parse_spec(text: str) -> FaultSpec:
             raise ValueError(f"{kind} needs a positive step, got {arg!r}")
     elif kind in _SERVE_KINDS:
         parts = arg.split(":")
-        spec.step = int(parts[0])
-        if kind in _SWAP_KINDS:
-            # checkpoint steps start at 0; busy ticks start at 1
-            if spec.step < 0:
+        if kind not in _SWAP_KINDS and parts[0][:1] == "t":
+            # time-based trigger (replica_crash:t3.5): fire on the first
+            # busy tick at/after this wall-clock offset from plan arm —
+            # the handle a storm bench needs to land a kill inside a
+            # replayed burst window, where the busy-tick count is load-
+            # dependent and unknowable up front
+            spec.at_s = float(parts[0][1:])
+            if spec.at_s <= 0:
                 raise ValueError(
-                    f"{kind} needs a checkpoint step >= 0, got {arg!r}"
+                    f"{kind} needs a positive time offset, got {arg!r}"
                 )
-        elif spec.step <= 0:
-            raise ValueError(f"{kind} needs a positive tick, got {arg!r}")
+        else:
+            spec.step = int(parts[0])
+            if kind in _SWAP_KINDS:
+                # checkpoint steps start at 0; busy ticks start at 1
+                if spec.step < 0:
+                    raise ValueError(
+                        f"{kind} needs a checkpoint step >= 0, got {arg!r}"
+                    )
+            elif spec.step <= 0:
+                raise ValueError(
+                    f"{kind} needs a positive tick, got {arg!r}"
+                )
         if kind in ("replica_hang", "swap_slow"):
             if len(parts) > 2:
                 raise ValueError(
@@ -204,6 +224,9 @@ class FaultPlan:
 
     def __init__(self, specs: list[FaultSpec]):
         self.specs = specs
+        # reference clock for t<seconds> serve triggers: offsets count
+        # from when this plan was armed (process start, in practice)
+        self.armed_t = time.monotonic()
 
     @classmethod
     def parse(cls, text: str | None) -> "FaultPlan":
@@ -256,8 +279,17 @@ class FaultPlan:
 
     def fire_serve_tick(self, busy_tick: int, elapsed_s: float) -> None:
         """Decode-engine hook, called after busy tick ``busy_tick`` (a tick
-        that admitted or decoded work) took ``elapsed_s`` seconds."""
-        spec = self._take("replica_crash", lambda s: s.step == busy_tick)
+        that admitted or decoded work) took ``elapsed_s`` seconds. A spec
+        matches by exact busy tick, or — ``t<seconds>`` triggers — on the
+        first busy tick at/after its wall-clock offset from plan arm."""
+        run_s = time.monotonic() - self.armed_t
+
+        def due(s: FaultSpec) -> bool:
+            if s.at_s > 0:
+                return run_s >= s.at_s
+            return s.step == busy_tick
+
+        spec = self._take("replica_crash", due)
         if spec is not None:
             _emit({"fault": "replica_crash", "tick": busy_tick})
             logger.warning(
@@ -266,7 +298,7 @@ class FaultPlan:
             self._flush_sink()
             os._exit(REPLICA_CRASH_EXIT_CODE)  # hard kill: no cleanup,
             # streams die mid-token — the failure the router must survive
-        spec = self._take("replica_hang", lambda s: s.step == busy_tick)
+        spec = self._take("replica_hang", due)
         if spec is not None:
             _emit({
                 "fault": "replica_hang", "tick": busy_tick,
@@ -283,7 +315,10 @@ class FaultPlan:
             if (
                 spec.kind == "replica_slow"
                 and spec.rank == pidx
-                and busy_tick >= spec.step
+                and (
+                    run_s >= spec.at_s if spec.at_s > 0
+                    else busy_tick >= spec.step
+                )
             ):
                 if not spec.fired:
                     spec.fired = True  # record the injection once; the

@@ -11,7 +11,10 @@ the repo with no way to SERVE a model; this package is that missing half:
                 are the XLA-static-shape stand-in for paged KV blocks);
 - ``queue``   — bounded admission queue: ``BackpressureError`` at max
                 depth, per-request deadlines, FIFO-within-bucket
-                scheduling;
+                scheduling, weighted SLO tier lanes (interactive/batch)
+                and the ``BrownoutController`` overload ladder (shed
+                batch -> clamp output budgets -> fail-fast interactive,
+                every step reversible);
 - ``server``  — the serve-loop thread plus stdin/JSONL and localhost HTTP
                 front-ends that stream tokens back per request; /healthz
                 reports ready/draining/unhealthy with live load for
@@ -25,7 +28,14 @@ the repo with no way to SERVE a model; this package is that missing half:
                 supervisor restart contract (crash -> backoff respawn
                 within a budget; SIGTERM -> drain, exit 75, respawn free),
                 plus the rolling-swap coordinator driving one-replica-at-
-                a-time checkpoint rollouts;
+                a-time checkpoint rollouts, and a dynamic pool
+                (``scale_up`` / ``retire_replica``) the autoscaler turns;
+- ``autoscale`` — queue-driven pool sizing with hysteresis + cooldowns
+                (grows via the spawn machinery, shrinks via the graceful
+                SIGTERM/exit-75 drain — no in-flight request dies);
+- ``trace``   — seeded open-loop traffic traces (Poisson base + burst
+                episodes, heavy-tailed sizes, SLO tiers) and the replay
+                driver behind ``bench.py --storm``;
 - ``hotswap`` — zero-downtime checkpoint hot-swap: a manifest-verified
                 watcher admits newly published steps (never twice, never
                 backwards, poisoned steps blocklisted), the replica-side
@@ -43,12 +53,17 @@ stretches tick time deterministically to drill deadline/backpressure
 paths. ``bench.py --serve`` is the closed-loop load generator.
 """
 
+from pytorch_distributed_training_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
+)
 from pytorch_distributed_training_tpu.serve.engine import (
     DecodeEngine,
     EngineConfig,
 )
 from pytorch_distributed_training_tpu.serve.queue import (
     BackpressureError,
+    BrownoutController,
     GenRequest,
     RequestQueue,
 )
@@ -56,6 +71,12 @@ from pytorch_distributed_training_tpu.serve.fleet import (
     FleetConfig,
     RollingSwapCoordinator,
     ServeFleet,
+)
+from pytorch_distributed_training_tpu.serve.trace import (
+    TraceConfig,
+    TraceEvent,
+    generate_trace,
+    replay,
 )
 from pytorch_distributed_training_tpu.serve.hotswap import (
     CheckpointWatcher,
@@ -75,7 +96,10 @@ from pytorch_distributed_training_tpu.serve.server import (
 )
 
 __all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
     "BackpressureError",
+    "BrownoutController",
     "CheckpointWatcher",
     "CircuitBreaker",
     "DecodeEngine",
@@ -89,8 +113,12 @@ __all__ = [
     "Router",
     "RouterConfig",
     "ServeFleet",
+    "TraceConfig",
+    "TraceEvent",
+    "generate_trace",
     "make_http_server",
     "make_router_http_server",
     "publish_params_checkpoint",
+    "replay",
     "serve_stdio",
 ]

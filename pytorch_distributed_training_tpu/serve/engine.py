@@ -334,6 +334,7 @@ class DecodeEngine:
         weights_step: Optional[int] = None,
         draft_model=None,
         draft_params=None,
+        brownout=None,
     ):
         cfg = model.config
         if not cfg.causal:
@@ -528,6 +529,16 @@ class DecodeEngine:
         self.finished = 0
         self.page_exhausted = 0     # ticks the FIFO head waited on pages
         self._page_blocked = False  # scratch flag for the admission pass
+        # Overload ladder (serve/queue.py BrownoutController): the tick loop
+        # feeds it queue pressure; the HTTP front-end reads its level at
+        # admission. Optional — a None brownout means "never degrade".
+        self.brownout = brownout
+        # Observed drain rate (finished requests/sec, EWMA over ~1s windows):
+        # the live half of the honest Retry-After estimate. Written only by
+        # the engine thread; read as one float from HTTP threads.
+        self.drain_rate = 0.0
+        self._drain_window_t = time.monotonic()
+        self._drain_window_finished = 0
         # liveness heartbeat: stamped at the end of every tick (including
         # idle ones — the serve loop re-ticks every idle-wait interval), so
         # /healthz can tell "loop wedged mid-tick" from "loop idle"
@@ -1197,6 +1208,7 @@ class DecodeEngine:
         reg.emit({
             "record": "serve_request",
             "id": req.id,
+            "tier": req.tier,
             "status": req.status,
             "finish_reason": req.finish_reason,
             "prompt_len": req.prompt_len,
@@ -1249,6 +1261,14 @@ class DecodeEngine:
     def slot_occupancy(self) -> float:
         n = sum(1 for s in self._slots if s is not None)
         return n / len(self._slots)
+
+    def page_occupancy(self) -> float:
+        """Fraction of the KV page pool in use (0.0 under dense layout) —
+        an autoscaler pressure signal alongside queue depth."""
+        if self._pages is None:
+            return 0.0
+        total = self._pages.num_pages - 1
+        return self._pages.pages_used / total if total > 0 else 0.0
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -1815,11 +1835,27 @@ class DecodeEngine:
             worked = True
 
         self.ticks += 1
-        self._registry.gauge("serve/queue_depth", self._queue.depth())
+        depth = self._queue.depth()
+        self._registry.gauge("serve/queue_depth", depth)
         self._registry.gauge("serve/slot_occupancy", self.slot_occupancy())
         if self._pages is not None:
             self._registry.gauge("serve/kv_pages_used", self._pages.pages_used)
             self._registry.gauge("serve/kv_pages_free", self._pages.pages_free)
+        if self.brownout is not None:
+            level = self.brownout.observe(depth / self._queue.max_depth)
+            self._registry.gauge("serve/brownout_level", level)
+        now = time.monotonic()
+        window = now - self._drain_window_t
+        if window >= 1.0:
+            rate = (self.finished - self._drain_window_finished) / window
+            # EWMA so one quiet window doesn't zero the estimate mid-storm
+            self.drain_rate = (
+                rate if self.drain_rate == 0.0
+                else 0.5 * self.drain_rate + 0.5 * rate
+            )
+            self._drain_window_t = now
+            self._drain_window_finished = self.finished
+            self._registry.gauge("serve/drain_rate_rps", self.drain_rate)
         if worked:
             self.busy_ticks += 1
             self._registry.observe("serve/tick", time.monotonic() - t0)
@@ -1864,7 +1900,13 @@ class DecodeEngine:
             "admitted": self.admitted,
             "finished": self.finished,
             "queue_depth": self._queue.depth(),
+            "queue_depth_by_tier": self._queue.depth_by_tier(),
             "slot_occupancy": self.slot_occupancy(),
+            "page_occupancy": self.page_occupancy(),
+            "drain_rate_rps": self.drain_rate,
+            "brownout": (
+                self.brownout.stats() if self.brownout is not None else None
+            ),
             "num_slots": self.config.num_slots,
             "prompt_buckets": list(self.config.prompt_buckets),
             "compiled_prefill_buckets": sorted(self._prefill_fns),

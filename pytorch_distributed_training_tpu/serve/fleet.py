@@ -18,11 +18,17 @@ serving:
   never sees the spec) — the same one-env-var chaos-drill story as
   training, now addressing members of a fleet.
 
-Ports are assigned up front (one free port per replica, reused across
-respawns) so the router's endpoint list is static while processes come and
-go behind it. Telemetry: ``replica_spawn`` / ``replica_exit`` /
-``replica_drain`` records in the fleet process's stream, which
-``scripts/summarize_metrics.py`` folds into the fleet section.
+Ports are assigned at replica construction and normally reused across
+respawns; if the bind races another process (exit 76,
+``PORT_IN_USE_EXIT_CODE``), the spawn path retries on a fresh port WITHOUT
+burning a restart and tells the router to re-qualify the new address. The
+pool itself is dynamic: ``scale_up()`` adds a replica through the same
+spawn machinery and ``retire_replica()`` removes one through the graceful
+SIGTERM -> exit-75 drain (no in-flight request dies) — the knobs
+``serve/autoscale.py`` turns. Telemetry: ``replica_spawn`` /
+``replica_exit`` / ``replica_drain`` / ``replica_port_retry`` /
+``fleet_scale`` records in the fleet process's stream, which
+``scripts/summarize_metrics.py`` folds into the fleet and storm sections.
 
 This module is jax-free on purpose: the fleet/router process does no
 accelerator work — all the jax lives in the replica subprocesses.
@@ -59,10 +65,23 @@ from pytorch_distributed_training_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+#: exit code a replica uses when its --http-port bind lost the race
+#: (EADDRINUSE). The supervisor treats it like exit 75: not a crash, no
+#: restart burned — the spawn path just retries on a fresh port.
+PORT_IN_USE_EXIT_CODE = 76
+
+#: bind-race retries per supervised attempt before the exit is treated as
+#: a real failure (each retry picks a fresh OS-assigned port, so repeated
+#: losses mean something is systematically wrong, not bad luck)
+MAX_PORT_RETRIES = 5
+
 
 def find_free_port(host: str = "127.0.0.1") -> int:
-    """An OS-assigned free TCP port (released immediately; the tiny window
-    before the replica binds it is acceptable for local fleets)."""
+    """An OS-assigned free TCP port (released immediately). The probe is
+    inherently TOCTOU — another process can claim the port before the
+    replica binds it — so the spawn path closes the race the only reliable
+    way: the replica exits ``PORT_IN_USE_EXIT_CODE`` when its bind fails
+    and the supervisor retries on a fresh port without burning a restart."""
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((host, 0))
@@ -142,6 +161,10 @@ class ReplicaProcess:
         self.restarts_used = 0
         self.graceful_exits = 0
         self.spawns = 0
+        self.port_retries = 0
+        # fleet wires this to the router so a bind-race port change
+        # propagates to the endpoint the health poll re-qualifies
+        self.on_port_change = None
         self._stopping = threading.Event()
         # the monitor thread mutates proc/state/counters; sigterm()/stop()/
         # describe() run on the fleet's control threads — one lock covers
@@ -156,8 +179,22 @@ class ReplicaProcess:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "ReplicaProcess":
+        self._export_budget()
         self._thread.start()
         return self
+
+    def budget_remaining(self) -> int:
+        """Restarts left before this replica goes ``failed`` for good."""
+        with self._lock:
+            return max(0, self._cfg.max_restarts - self.restarts_used)
+
+    def _export_budget(self) -> None:
+        # per-replica gauge: a storm that eats the restart budget shows up
+        # as this hitting 0, in telemetry instead of log archaeology
+        self._registry.gauge(
+            f"fleet/restart_budget_remaining/{self.name}",
+            self.budget_remaining(),
+        )
 
     def _argv(self) -> list:
         return [
@@ -181,27 +218,63 @@ class ReplicaProcess:
         return env
 
     def _spawn_and_wait(self, attempt: int) -> None:
-        """One supervised attempt: spawn, record, wait, classify the exit."""
-        proc = subprocess.Popen(
-            self._argv(), env=self._env(),
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        with self._lock:
-            self.spawns += 1
-            self.proc = proc
-            self.state = "up"
-        logger.info(
-            "replica %s spawned pid=%d port=%d attempt=%d",
-            self.name, proc.pid, self.port, attempt,
-        )
-        self._registry.emit({
-            "record": "replica_spawn",
-            "replica": self.name,
-            "pid": proc.pid,
-            "port": self.port,
-            "attempt": attempt,
-        })
-        rc = proc.wait()
+        """One supervised attempt: spawn, record, wait, classify the exit.
+
+        A bind-race exit (``PORT_IN_USE_EXIT_CODE``) loops HERE, inside the
+        attempt — a fresh port, a router rebind notification, respawn — so
+        ``run_with_restarts`` never sees it and the restart budget stays
+        whole. Only repeated losses (``MAX_PORT_RETRIES``) fall through to
+        the crash path."""
+        port_tries = 0
+        while True:
+            proc = subprocess.Popen(
+                self._argv(), env=self._env(),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            with self._lock:
+                self.spawns += 1
+                self.proc = proc
+                self.state = "up"
+            logger.info(
+                "replica %s spawned pid=%d port=%d attempt=%d",
+                self.name, proc.pid, self.port, attempt,
+            )
+            self._registry.emit({
+                "record": "replica_spawn",
+                "replica": self.name,
+                "pid": proc.pid,
+                "port": self.port,
+                "attempt": attempt,
+            })
+            rc = proc.wait()
+            if (
+                rc != PORT_IN_USE_EXIT_CODE
+                or self._stopping.is_set()
+                or port_tries >= MAX_PORT_RETRIES
+            ):
+                break
+            port_tries += 1
+            old_port = self.port
+            new_port = find_free_port(self._cfg.host)
+            with self._lock:
+                self.port = new_port
+                self.port_retries += 1
+            logger.warning(
+                "replica %s lost the bind race on port %d; retrying on "
+                "%d (%d/%d)", self.name, old_port, new_port,
+                port_tries, MAX_PORT_RETRIES,
+            )
+            self._registry.inc("fleet/port_retries")
+            self._registry.emit({
+                "record": "replica_port_retry",
+                "replica": self.name,
+                "old_port": old_port,
+                "new_port": new_port,
+                "try": port_tries,
+            })
+            cb = self.on_port_change
+            if cb is not None:
+                cb(self)
         graceful = rc == RESUMABLE_EXIT_CODE
         with self._lock:
             sigterm_t = self._sigterm_t
@@ -283,6 +356,7 @@ class ReplicaProcess:
         if i > 0:
             with self._lock:
                 self.restarts_used += 1
+            self._export_budget()
         if self._stopping.is_set():
             return
         self._spawn_and_wait(i)
@@ -336,15 +410,21 @@ class ReplicaProcess:
             spawns = self.spawns
             restarts_used = self.restarts_used
             graceful_exits = self.graceful_exits
+            port = self.port
+            port_retries = self.port_retries
         return {
             "replica": self.name,
-            "port": self.port,
+            "port": port,
             "state": state,
             "pid": proc.pid if proc is not None else None,
             "alive": proc is not None and proc.poll() is None,
             "spawns": spawns,
             "restarts_used": restarts_used,
+            "restart_budget_remaining": max(
+                0, self._cfg.max_restarts - restarts_used
+            ),
             "graceful_exits": graceful_exits,
+            "port_retries": port_retries,
         }
 
 
@@ -536,7 +616,20 @@ class ServeFleet:
             router_config,
             registry=registry,
         )
+        self.router.pool_status_fn = self.pool_status
+        # pool membership changes (autoscaler scale-up/retire) vs the
+        # readers in stop/stats/rolling-swap: mutations replace the list
+        # atomically under this lock, readers snapshot it
+        self._pool_lock = concurrency.lock("serve.fleet.pool")
+        self._next_index = fleet_config.num_replicas
+        self.scale_ups = 0
+        self.scale_downs = 0
+        for replica in self.replicas:
+            replica.on_port_change = self._port_changed
         self.hotswap: Optional[RollingSwapCoordinator] = None
+
+    def _port_changed(self, replica: ReplicaProcess) -> None:
+        self.router.update_endpoint_port(replica.name, replica.port)
 
     def enable_hotswap(
         self,
@@ -585,22 +678,112 @@ class ServeFleet:
     def replica(self, index: int) -> ReplicaProcess:
         return self.replicas[index]
 
+    # -------------------------------------------------------- dynamic pool
+
+    def scale_up(self) -> ReplicaProcess:
+        """Add one replica through the normal spawn machinery. It takes
+        traffic only after the router's health poll qualifies it (the
+        add_endpoint readiness gate), so callers can fire-and-forget."""
+        with self._pool_lock:
+            index = self._next_index
+            self._next_index += 1
+            replica = ReplicaProcess(
+                index, find_free_port(self.config.host), self.config,
+                self._registry,
+            )
+            replica.on_port_change = self._port_changed
+            self.replicas = self.replicas + [replica]
+            self.scale_ups += 1
+        self.router.add_endpoint(replica.name, self.config.host, replica.port)
+        replica.start()
+        self._registry.inc("fleet/scale_ups")
+        self._registry.emit({
+            "record": "fleet_scale",
+            "action": "up",
+            "replica": replica.name,
+            "port": replica.port,
+            "size": len(self.replicas),
+        })
+        return replica
+
+    def retire_replica(self) -> Optional[str]:
+        """Remove one replica gracefully: SIGTERM -> drain -> exit 75, the
+        same path a preemption takes, so every in-flight request finishes.
+        Newest capacity leaves first (LIFO keeps the stable seed replicas).
+        Refuses to retire the last live replica. Returns the retiring
+        replica's name immediately; a background waiter deregisters it
+        from the router once the drain completes."""
+        with self._pool_lock:
+            live = [
+                r for r in self.replicas if r.state in ("starting", "up")
+            ]
+            if len(live) <= 1:
+                return None
+            replica = live[-1]
+        t0 = time.monotonic()
+        replica.stop(drain=True)
+
+        def _finish() -> None:
+            replica.join(self.config.drain_timeout_s + 10.0)
+            with self._pool_lock:
+                self.replicas = [r for r in self.replicas if r is not replica]
+                self.scale_downs += 1
+            self.router.remove_endpoint(replica.name)
+            self._registry.inc("fleet/scale_downs")
+            self._registry.emit({
+                "record": "fleet_scale",
+                "action": "down",
+                "replica": replica.name,
+                "drain_s": time.monotonic() - t0,
+                "size": len(self.replicas),
+            })
+
+        threading.Thread(
+            target=_finish, name=f"fleet-retire-{replica.name}", daemon=True
+        ).start()
+        return replica.name
+
+    def pool_status(self) -> dict:
+        """Pool health for /stats and the router's fail-fast body. A pool
+        is ``degraded`` when any member exhausted its restart budget — the
+        failure mode client backoff cannot fix."""
+        replicas = list(self.replicas)
+        failed = [r.name for r in replicas if r.state == "failed"]
+        return {
+            "size": len(replicas),
+            "up": sum(1 for r in replicas if r.state == "up"),
+            "failed": failed,
+            "degraded": bool(failed),
+            "reason": (
+                "pool degraded: restart budget exhausted for "
+                + ",".join(failed)
+                if failed else None
+            ),
+            "restart_budget_remaining": {
+                r.name: r.budget_remaining() for r in replicas
+            },
+        }
+
     def stop(self, *, drain: bool = True) -> None:
         """Drain (or kill) every replica, stop respawns, stop the router
         (and the rollout coordinator first — no swap starts mid-drain)."""
         if self.hotswap is not None:
             self.hotswap.close()
-        for replica in self.replicas:
+        replicas = list(self.replicas)
+        for replica in replicas:
             replica.stop(drain=drain)
         join_s = self.config.drain_timeout_s + 10.0 if drain else 10.0
-        for replica in self.replicas:
+        for replica in replicas:
             replica.join(join_s)
         self.router.close()
 
     def stats(self) -> dict:
         stats = {
-            "replicas": [r.describe() for r in self.replicas],
+            "replicas": [r.describe() for r in list(self.replicas)],
             "router": self.router.stats(),
+            "pool": self.pool_status(),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
         }
         if self.hotswap is not None:
             stats["hotswap"] = self.hotswap.stats()

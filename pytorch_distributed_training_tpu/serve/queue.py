@@ -17,6 +17,21 @@ policies live here and nowhere else:
   keeps XLA compilation bounded); within a bucket order is strict FIFO,
   and across buckets the scheduler picks the earliest-submitted head — no
   bucket can starve another.
+- **SLO tier lanes**: every request carries a tier (``interactive`` |
+  ``batch``) and each tier is its own lane of buckets. ``pop_ready``
+  arbitrates between lanes by deterministic weighted round-robin (default
+  4:1 in favor of interactive), falling through to the other lane when
+  the scheduled one is empty — weighted share under contention, work-
+  conserving when one lane is idle. The no-bypass rule is PER LANE: a
+  lane head blocked on pages is never bypassed by requests of its own
+  tier, but it cannot stall the other lane (a giant batch request waiting
+  for pages must not freeze interactive traffic).
+
+``BrownoutController`` also lives here: the fixed, reversible overload
+ladder (shed batch -> clamp output budgets -> fail-fast interactive) that
+the engine's tick loop drives from queue pressure and the HTTP front-end
+enforces at admission. Degrading is a queue policy, so it sits with the
+other queue policies.
 """
 
 from __future__ import annotations
@@ -34,6 +49,15 @@ from pytorch_distributed_training_tpu.analysis import concurrency
 
 class BackpressureError(RuntimeError):
     """The queue is at ``max_depth`` — resubmit later (HTTP front-end: 429)."""
+
+
+#: the service tiers the queue schedules as lanes; order is the brownout
+#: shed order REVERSED (batch is shed first, interactive last)
+TIERS = ("interactive", "batch")
+
+#: default weighted-round-robin share per lane: under contention the
+#: scheduler admits 4 interactive requests for every batch request
+DEFAULT_TIER_WEIGHTS = {"interactive": 4, "batch": 1}
 
 
 def emit_expiry(registry, request: "GenRequest", phase: str) -> None:
@@ -73,6 +97,7 @@ class GenRequest:
     max_new_tokens: int
     temperature: float = 0.0                # 0 = greedy
     top_k: int = 0
+    tier: str = "interactive"               # SLO lane: interactive | batch
     eot_id: Optional[int] = None
     seed: int = 0                           # per-request sampling stream
     deadline_s: Optional[float] = None      # relative to submit
@@ -122,6 +147,7 @@ class RequestQueue:
         max_depth: int,
         prompt_buckets: tuple,
         max_new_tokens: int,
+        tier_weights: Optional[dict] = None,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -132,12 +158,29 @@ class RequestQueue:
                 f"prompt_buckets must be sorted unique positive lengths, "
                 f"got {prompt_buckets!r}"
             )
+        weights = dict(tier_weights or DEFAULT_TIER_WEIGHTS)
+        if set(weights) != set(TIERS) or any(
+            int(w) < 1 for w in weights.values()
+        ):
+            raise ValueError(
+                f"tier_weights needs a positive weight per tier {TIERS}, "
+                f"got {weights!r}"
+            )
         self.max_depth = max_depth
         self.prompt_buckets = tuple(int(b) for b in prompt_buckets)
         self.max_new_tokens = max_new_tokens
-        self._buckets: dict[int, deque] = {
-            b: deque() for b in self.prompt_buckets
+        self.tier_weights = {t: int(weights[t]) for t in TIERS}
+        # one lane of buckets per tier; the weighted-round-robin schedule
+        # is the expansion of the weights (e.g. I,I,I,I,B for 4:1) and the
+        # cursor advances one slot per successful pop
+        self._lanes: dict[str, dict[int, deque]] = {
+            tier: {b: deque() for b in self.prompt_buckets}
+            for tier in TIERS
         }
+        self._schedule = tuple(
+            tier for tier in TIERS for _ in range(self.tier_weights[tier])
+        )
+        self._cursor = 0
         # instrumented (analysis/concurrency): every front-end thread and
         # the engine contend here — the locks telemetry section shows it
         self._lock = concurrency.lock("serve.queue")
@@ -172,6 +215,10 @@ class RequestQueue:
             raise ValueError(
                 f"temperature must be finite, got {request.temperature}"
             )
+        if request.tier not in TIERS:
+            raise ValueError(
+                f"tier must be one of {TIERS}, got {request.tier!r}"
+            )
         bucket = self.bucket_for(request.prompt_len)
         with self._lock:
             if self._closed:
@@ -183,7 +230,7 @@ class RequestQueue:
             request.bucket = bucket
             request.status = "queued"
             request.submit_t = time.monotonic()
-            self._buckets[bucket].append(request)
+            self._lanes[request.tier][bucket].append(request)
             self._work.notify_all()
         return request
 
@@ -192,7 +239,16 @@ class RequestQueue:
     def depth(self) -> int:
         """Queued-request count (caller may hold the lock; reads are safe
         either way — deque lengths are atomic)."""
-        return sum(len(d) for d in self._buckets.values())
+        return sum(
+            len(d) for lane in self._lanes.values() for d in lane.values()
+        )
+
+    def depth_by_tier(self) -> dict:
+        """Queued-request count per lane (telemetry + autoscaler signal)."""
+        return {
+            tier: sum(len(d) for d in lane.values())
+            for tier, lane in self._lanes.items()
+        }
 
     def expire_overdue(self, now: Optional[float] = None) -> list:
         """Remove and return every queued request past its deadline (the
@@ -200,17 +256,33 @@ class RequestQueue:
         now = time.monotonic() if now is None else now
         expired = []
         with self._lock:
-            for dq in self._buckets.values():
-                keep = deque()
-                while dq:
-                    req = dq.popleft()
-                    (expired if req.overdue(now) else keep).append(req)
-                dq.extend(keep)
+            for lane in self._lanes.values():
+                for dq in lane.values():
+                    keep = deque()
+                    while dq:
+                        req = dq.popleft()
+                        (expired if req.overdue(now) else keep).append(req)
+                    dq.extend(keep)
         return expired
 
+    def _lane_head(self, tier: str) -> Optional[deque]:
+        """The earliest-submitted bucket head within one lane (unchanged
+        FIFO-within-bucket / earliest-head-across-buckets rule)."""
+        head = None
+        for dq in self._lanes[tier].values():
+            if dq and (head is None or dq[0].submit_t < head[0].submit_t):
+                head = dq
+        return head
+
     def pop_ready(self, accept=None, defer=None) -> Optional[GenRequest]:
-        """FIFO-within-bucket pop: the earliest-submitted request among the
-        bucket heads, or None when idle.
+        """Weighted-lane pop: pick a tier lane by weighted round-robin,
+        then the earliest-submitted request among that lane's bucket
+        heads; None when idle.
+
+        Lane arbitration: the schedule cycles through tiers proportionally
+        to ``tier_weights`` (advancing only on successful pops, so the
+        share holds under contention); an empty lane never consumes a
+        schedule slot — one busy lane gets every pop (work-conserving).
 
         ``defer`` (optional) is a TRANSIENT hold predicate checked before
         ``accept``: when it returns True for the head, the pop returns None
@@ -222,22 +294,34 @@ class RequestQueue:
         and the hold must not inflate ``serve/page_exhausted``).
 
         ``accept`` (optional) is an admission predicate on the candidate
-        head — the engine's page-budget check. When the scheduler-order
-        head is rejected the pop returns None WITHOUT trying later
-        requests: strict no-bypass FIFO, so a big request blocked on pages
-        is never starved by a stream of small ones slipping past it."""
+        head — the engine's page-budget check. Rejection is no-bypass PER
+        LANE: when a lane's head is rejected, no later request of that
+        lane is tried (a big request blocked on pages is never starved by
+        small ones of its own tier slipping past it), but the OTHER lane's
+        head still gets its look — a page-blocked batch giant must not
+        freeze interactive traffic."""
         with self._lock:
-            head = None
-            for dq in self._buckets.values():
-                if dq and (head is None or dq[0].submit_t < head[0].submit_t):
-                    head = dq
-            if head is None:
-                return None
-            if defer is not None and defer(head[0]):
-                return None
-            if accept is not None and not accept(head[0]):
-                return None
-            return head.popleft()
+            tried: set = set()
+            for offset in range(len(self._schedule)):
+                tier = self._schedule[
+                    (self._cursor + offset) % len(self._schedule)
+                ]
+                if tier in tried:
+                    continue
+                tried.add(tier)
+                head = self._lane_head(tier)
+                if head is None:
+                    continue
+                if defer is not None and defer(head[0]):
+                    # transient engine-wide hold: nothing pops this tick
+                    return None
+                if accept is not None and not accept(head[0]):
+                    continue        # lane head blocked; other lane may go
+                self._cursor = (self._cursor + offset + 1) % len(
+                    self._schedule
+                )
+                return head.popleft()
+            return None
 
     def wait_for_work(self, timeout: float) -> bool:
         """Engine-side idle wait; returns True when work may be available."""
@@ -263,7 +347,162 @@ class RequestQueue:
         path: the server cancels them)."""
         with self._lock:
             out = []
-            for dq in self._buckets.values():
-                out.extend(dq)
-                dq.clear()
+            for lane in self._lanes.values():
+                for dq in lane.values():
+                    out.extend(dq)
+                    dq.clear()
         return out
+
+
+# ------------------------------------------------------------------ brownout
+
+
+#: the fixed degradation ladder, in escalation order. Every transition is
+#: one step at a time and reversible — recovery retraces the ladder down.
+BROWNOUT_LEVELS = ("normal", "shed_batch", "clamp", "fail_fast")
+
+
+class BrownoutController:
+    """Reversible overload ladder driven by sustained queue pressure.
+
+    The engine's tick loop feeds ``observe(pressure)`` (pressure = queue
+    depth / max depth); the controller escalates one level at a time after
+    the pressure holds above ``high_watermark`` for ``escalate_hold_s``,
+    and de-escalates one level at a time after it holds below
+    ``low_watermark`` for ``deescalate_hold_s`` — hysteresis plus hold
+    times, so a flapping gauge cannot flap the policy. The HTTP front-end
+    enforces the current level at admission:
+
+    - level >= 1 (``shed_batch``): new batch-tier requests are rejected
+      (429 + honest Retry-After). Interactive traffic is untouched.
+    - level >= 2 (``clamp``): newly admitted requests have their output
+      budget clamped to ``clamp_max_new`` — shorter answers for everyone
+      beats no answers for some. Already-running requests keep their
+      budget (the clamp is admission-time, hence trivially reversible).
+    - level >= 3 (``fail_fast``): even interactive requests are rejected
+      (503 + honest Retry-After) — the queue can no longer meet the
+      interactive deadline, so an explicit fast "come back later" is the
+      only honest answer left. Never a silent stall.
+
+    ``now_fn`` is injectable; tests drive the ladder with a fake clock.
+    Mutations happen on the engine thread under a named lock; the hot-path
+    policy queries read ``level`` once (atomic int read) from any thread.
+    """
+
+    def __init__(
+        self,
+        *,
+        high_watermark: float = 0.8,
+        low_watermark: float = 0.3,
+        escalate_hold_s: float = 0.5,
+        deescalate_hold_s: float = 1.0,
+        clamp_max_new: int = 16,
+        now_fn=None,
+        registry=None,
+    ):
+        if not 0.0 < low_watermark < high_watermark:
+            raise ValueError(
+                f"need 0 < low_watermark < high_watermark, got "
+                f"{low_watermark} / {high_watermark}"
+            )
+        if clamp_max_new < 1:
+            raise ValueError(
+                f"clamp_max_new must be >= 1, got {clamp_max_new}"
+            )
+        self.high_watermark = high_watermark
+        self.low_watermark = low_watermark
+        self.escalate_hold_s = escalate_hold_s
+        self.deescalate_hold_s = deescalate_hold_s
+        self.clamp_max_new = clamp_max_new
+        self._now = now_fn if now_fn is not None else time.monotonic
+        self._registry = registry
+        self.level = 0
+        self.escalations = 0
+        self.deescalations = 0
+        self._above_t: Optional[float] = None
+        self._below_t: Optional[float] = None
+        self._lock = concurrency.lock("serve.brownout")
+
+    # ------------------------------------------------------------- observe
+
+    def observe(self, pressure: float) -> int:
+        """One pressure sample (engine thread, once per tick); returns the
+        current level. Crossing back into the hysteresis band resets both
+        hold timers — only SUSTAINED pressure moves the ladder."""
+        now = self._now()
+        with self._lock:
+            if pressure >= self.high_watermark:
+                self._below_t = None
+                if self._above_t is None:
+                    self._above_t = now
+                if (
+                    self.level < len(BROWNOUT_LEVELS) - 1
+                    and now - self._above_t >= self.escalate_hold_s
+                ):
+                    self._transition(self.level + 1, pressure)
+                    self._above_t = now     # next level needs its own hold
+            elif pressure <= self.low_watermark:
+                self._above_t = None
+                if self._below_t is None:
+                    self._below_t = now
+                if (
+                    self.level > 0
+                    and now - self._below_t >= self.deescalate_hold_s
+                ):
+                    self._transition(self.level - 1, pressure)
+                    self._below_t = now
+            else:
+                self._above_t = None
+                self._below_t = None
+            return self.level
+
+    def _transition(self, new_level: int, pressure: float) -> None:
+        old = self.level
+        self.level = new_level
+        if new_level > old:
+            self.escalations += 1
+        else:
+            self.deescalations += 1
+        if self._registry is not None:
+            self._registry.inc(
+                "serve/brownout_escalations"
+                if new_level > old
+                else "serve/brownout_deescalations"
+            )
+            self._registry.gauge("serve/brownout_level", new_level)
+            self._registry.emit({
+                "record": "brownout_transition",
+                "from": BROWNOUT_LEVELS[old],
+                "to": BROWNOUT_LEVELS[new_level],
+                "level": new_level,
+                "pressure": pressure,
+            })
+
+    # ------------------------------------------------------ policy queries
+
+    def level_name(self) -> str:
+        return BROWNOUT_LEVELS[self.level]
+
+    def sheds(self, tier: str) -> bool:
+        """Is a NEW request of ``tier`` rejected at the current level?
+        Batch sheds from level 1; interactive only at the final level —
+        the ordering the acceptance tests pin."""
+        level = self.level
+        if tier == "batch":
+            return level >= 1
+        return level >= 3
+
+    def clamp(self, max_new_tokens: int) -> int:
+        """The admitted output budget at the current level (identity below
+        the clamp level)."""
+        if self.level >= 2:
+            return min(max_new_tokens, self.clamp_max_new)
+        return max_new_tokens
+
+    def stats(self) -> dict:
+        return {
+            "level": self.level,
+            "level_name": self.level_name(),
+            "escalations": self.escalations,
+            "deescalations": self.deescalations,
+        }

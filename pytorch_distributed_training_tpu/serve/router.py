@@ -338,6 +338,87 @@ class Router:
         self.rejected = 0
         self._last_weights: dict = {}       # replica -> last seen step
         self._last_skew_sig: Optional[tuple] = None
+        # optional pool-state callback (wired by ServeFleet): folded into
+        # /stats and the fail-fast 503 body so "pool degraded, restart
+        # budget exhausted" is diagnosable from the rejection itself
+        self.pool_status_fn = None
+
+    # ------------------------------------------------------- dynamic pool
+
+    def _pool(self):
+        """Lock-guarded snapshot of the replica list. Mutators REPLACE the
+        list under the lock (copy-on-write), never mutate it in place, so
+        the returned reference is a stable snapshot safe to iterate without
+        holding the lock."""
+        with self._lock:
+            return self.replicas
+
+    def _make_replica(self, name: str, host: str, port: int) -> Replica:
+        return Replica(
+            name, host, port,
+            breaker=CircuitBreaker(
+                threshold=self.config.breaker_threshold,
+                cooldown_s=self.config.breaker_cooldown_s,
+                on_transition=self._breaker_transition_cb(name),
+                name=name,
+            ),
+        )
+
+    def add_endpoint(self, name: str, host: str, port: int) -> Replica:
+        """Register a new replica endpoint (autoscaler scale-up). The
+        replica enters rotation only after its first successful health
+        probe (``last_ready_t`` gate) — registering a still-booting process
+        is safe. The pool list is replaced atomically, so concurrent
+        readers (pick/health/stats) see either the old or the new list."""
+        replica = self._make_replica(name, host, port)
+        with self._lock:
+            if any(r.name == name for r in self.replicas):
+                raise ValueError(f"endpoint {name!r} already registered")
+            self.replicas = self.replicas + [replica]
+            size = len(self.replicas)
+        self._registry.emit({
+            "record": "router_pool",
+            "action": "add",
+            "replica": name,
+            "port": port,
+            "size": size,
+        })
+        return replica
+
+    def remove_endpoint(self, name: str) -> bool:
+        """Deregister a replica endpoint (autoscaler scale-down, after its
+        drain completed). Refuses to empty the pool."""
+        with self._lock:
+            keep = [r for r in self.replicas if r.name != name]
+            if len(keep) == len(self.replicas):
+                return False
+            if not keep:
+                raise ValueError("cannot remove the last replica endpoint")
+            self.replicas = keep
+        self._registry.emit({
+            "record": "router_pool",
+            "action": "remove",
+            "replica": name,
+            "size": len(keep),
+        })
+        return True
+
+    def update_endpoint_port(self, name: str, port: int) -> bool:
+        """A replica rebound to a fresh port (bind-race retry in the spawn
+        path). Readiness resets so the next health probe re-qualifies the
+        new address before it takes traffic."""
+        for replica in self._pool():
+            if replica.name == name:
+                replica.port = port
+                replica.last_ready_t = None
+                self._registry.emit({
+                    "record": "router_pool",
+                    "action": "rebind",
+                    "replica": name,
+                    "port": port,
+                })
+                return True
+        return False
 
     # -------------------------------------------------------------- health
 
@@ -371,7 +452,7 @@ class Router:
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.config.health_interval_s):
-            for replica in self.replicas:
+            for replica in self._pool():
                 if not replica.breaker.allow_probe():
                     continue        # open circuit, cooldown not yet over
                 self.check_replica(replica)
@@ -437,7 +518,7 @@ class Router:
             })
         sig = tuple(
             sorted(
-                (r.name, r.weights_step) for r in self.replicas
+                (r.name, r.weights_step) for r in self._pool()
                 if r.weights_step is not None
             )
         )
@@ -451,7 +532,7 @@ class Router:
             self._registry.emit({
                 "record": "router_skew",
                 "weights": {
-                    r.name: r.weights_step for r in self.replicas
+                    r.name: r.weights_step for r in self._pool()
                 },
                 "skew": skew,
             })
@@ -460,7 +541,7 @@ class Router:
         """Distinct weights versions across replicas reporting one, minus
         one — 0 means the pool is converged on a single checkpoint step."""
         steps = {
-            r.weights_step for r in self.replicas
+            r.weights_step for r in self._pool()
             if r.weights_step is not None
         }
         return max(0, len(steps) - 1)
@@ -470,7 +551,7 @@ class Router:
     def pick(self, exclude: frozenset = frozenset()) -> Optional[Replica]:
         """Least-loaded available replica (round-robin on ties), or None."""
         candidates = [
-            r for r in self.replicas
+            r for r in self._pool()
             if r.name not in exclude and r.available()
         ]
         if not candidates:
@@ -484,7 +565,7 @@ class Router:
     def retry_after_s(self) -> int:
         """Advice for a rejected client: the earliest moment the pool could
         look different — a breaker half-opening, or the next health poll."""
-        waits = [r.breaker.reopen_in() for r in self.replicas]
+        waits = [r.breaker.reopen_in() for r in self._pool()]
         waits = [w for w in waits if w is not None]
         best = min(waits) if waits else self.config.health_interval_s
         return max(1, int(best + 0.999))
@@ -583,7 +664,7 @@ class Router:
 
         total_s = time.monotonic() - t0
         served_by = next(
-            (r for r in self.replicas if r.name == outcome.get("replica")),
+            (r for r in self._pool() if r.name == outcome.get("replica")),
             None,
         )
         self._registry.emit({
@@ -718,19 +799,28 @@ class Router:
     # --------------------------------------------------------------- stats
 
     def available_count(self) -> int:
-        return sum(1 for r in self.replicas if r.available())
+        return sum(1 for r in self._pool() if r.available())
+
+    def pool_status(self) -> Optional[dict]:
+        """The fleet's pool view (None for a router without a fleet)."""
+        fn = self.pool_status_fn
+        return fn() if fn is not None else None
 
     def stats(self) -> dict:
-        return {
-            "replicas": [r.describe() for r in self.replicas],
+        stats = {
+            "replicas": [r.describe() for r in self._pool()],
             "available": self.available_count(),
             "routed": self.routed,
             "failovers": self.failovers,
             "hedges": self.hedges,
             "rejected": self.rejected,
-            "weights": {r.name: r.weights_step for r in self.replicas},
+            "weights": {r.name: r.weights_step for r in self._pool()},
             "version_skew": self.version_skew(),
         }
+        pool = self.pool_status()
+        if pool is not None:
+            stats["pool"] = pool
+        return stats
 
 
 # ---------------------------------------------------------------- http
@@ -803,11 +893,19 @@ def make_router_http_server(router: Router, host: str = "127.0.0.1",
             outcome = router.route_generate(body, rid, write_line)
             if outcome["status"] == "rejected" and not headers_sent.is_set():
                 code = outcome.get("code") or 503
-                self._json(code, {
+                reject = {
                     "error": "no replica available"
                     if code == 503 else "all replicas busy",
                     "id": rid,
-                }, headers={
+                }
+                # a degraded pool changes the advice: no amount of client
+                # backoff revives a replica whose restart budget is gone,
+                # so say it in the rejection instead of burying it in logs
+                pool = router.pool_status()
+                if pool is not None and pool.get("degraded"):
+                    reject["pool"] = pool
+                    reject["error"] += f" ({pool.get('reason')})"
+                self._json(code, reject, headers={
                     "Retry-After": outcome.get("retry_after")
                     or router.retry_after_s(),
                     "X-Request-Id": rid,

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import threading
 import time
 import uuid
@@ -46,6 +47,7 @@ from pytorch_distributed_training_tpu.serve.engine import (
     EngineConfig,
 )
 from pytorch_distributed_training_tpu.serve.queue import (
+    TIERS,
     BackpressureError,
     GenRequest,
     RequestQueue,
@@ -68,6 +70,9 @@ class InferenceServer:
         *,
         queue_depth: int = 16,
         default_deadline_s: Optional[float] = None,
+        tier_deadlines: Optional[dict] = None,
+        tier_weights: Optional[dict] = None,
+        brownout=None,
         registry=None,
         guards=None,
         stall_timeout_s: float = 10.0,
@@ -75,17 +80,27 @@ class InferenceServer:
         draft_model=None,
         draft_params=None,
     ):
+        if tier_deadlines is not None:
+            bad = set(tier_deadlines) - set(TIERS)
+            if bad:
+                raise ValueError(f"unknown tiers in tier_deadlines: {bad}")
         self.queue = RequestQueue(
             max_depth=queue_depth,
             prompt_buckets=config.prompt_buckets,
             max_new_tokens=config.max_new_tokens,
+            tier_weights=tier_weights,
         )
         self.engine = DecodeEngine(
             model, params, config, self.queue, registry=registry,
             guards=guards, weights_step=weights_step,
             draft_model=draft_model, draft_params=draft_params,
+            brownout=brownout,
         )
+        self.registry = self.engine._registry
         self.default_deadline_s = default_deadline_s
+        # per-tier SLO deadlines (interactive tight, batch loose); a tier
+        # absent here falls back to default_deadline_s
+        self.tier_deadlines = dict(tier_deadlines or {})
         self.stall_timeout_s = stall_timeout_s
         # replica-side hot-swap executor (serve/hotswap.py), attached by
         # the CLI when a checkpoint directory exists; enables POST /swap
@@ -181,6 +196,7 @@ class InferenceServer:
         eot_id: Optional[int] = None,
         seed: int = 0,
         deadline_s: Optional[float] = None,
+        tier: str = "interactive",
         stream=None,
         on_finish=None,
         request_id: Optional[str] = None,
@@ -188,18 +204,20 @@ class InferenceServer:
     ) -> GenRequest:
         """Enqueue one request (any thread). Raises ``BackpressureError``
         when the queue is full; the request's ``done`` event fires at every
-        terminal state."""
+        terminal state. Deadline precedence: explicit ``deadline_s``, then
+        the tier's SLO deadline, then ``default_deadline_s``."""
+        if deadline_s is None:
+            deadline_s = self.tier_deadlines.get(tier, self.default_deadline_s)
         req = GenRequest(
             id=request_id or f"r{next(self._ids)}",
             prompt_ids=np.asarray(prompt_ids, np.int32).reshape(-1),
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_k=top_k,
+            tier=tier,
             eot_id=eot_id,
             seed=seed,
-            deadline_s=(
-                deadline_s if deadline_s is not None else self.default_deadline_s
-            ),
+            deadline_s=deadline_s,
             stream=stream,
             on_finish=on_finish,
             spec=spec,
@@ -264,6 +282,13 @@ class InferenceServer:
             "slot_occupancy": self.engine.slot_occupancy(),
             "num_slots": self.engine.config.num_slots,
             "queue_capacity": self.queue.max_depth,
+            # autoscaler pressure signals: KV page-pool occupancy and the
+            # current brownout rung (0 when no controller is attached)
+            "page_occupancy": self.engine.page_occupancy(),
+            "brownout_level": (
+                self.engine.brownout.level
+                if self.engine.brownout is not None else 0
+            ),
             # the weights version this replica answers from — routers use
             # it for pool version-skew telemetry during a rolling swap
             "weights_step": self.engine.weights_step,
@@ -372,6 +397,7 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
                 eot_id=eot_id,
                 seed=int(msg.get("seed", 0)),
                 deadline_s=msg.get("deadline_s"),
+                tier=msg.get("tier", "interactive"),
                 stream=on_token,
                 on_finish=on_finish,
                 request_id=msg.get("id"),
@@ -390,10 +416,29 @@ def serve_stdio(server: InferenceServer, tokenizer, in_stream, out_stream) -> in
 # -------------------------------------------------------------------- http
 
 
-#: Retry-After seconds advertised on 429 (queue full — drains in request
-#: time) and on 503 while draining (a replacement replica needs to boot).
+#: Retry-After FLOORS for 429 (queue full — drains in request time) and
+#: 503 while draining (a replacement replica needs to boot). The advertised
+#: value is a live estimate — queue depth / observed drain rate — clamped
+#: between the path's floor and ``RETRY_AFTER_CEILING_S``; the floors keep
+#: their old values so an engine with no drain history answers exactly what
+#: the hard-coded constants used to say.
 BACKPRESSURE_RETRY_AFTER_S = 1
 DRAINING_RETRY_AFTER_S = 5
+RETRY_AFTER_CEILING_S = 30
+
+
+def retry_after_estimate(server: InferenceServer, *, floor: int) -> int:
+    """Honest Retry-After seconds: how long the CURRENT queue takes to
+    drain at the observed finish rate, bounded to [floor, ceiling]. With no
+    drain history yet (cold engine) the floor is the only defensible
+    number. Clients and the router forward this value verbatim, so a
+    storm's rejections carry real backoff guidance instead of a constant
+    that is wrong in both directions."""
+    rate = server.engine.drain_rate
+    if rate <= 0.0:
+        return floor
+    est = math.ceil(server.queue.depth() / rate)
+    return max(floor, min(RETRY_AFTER_CEILING_S, est))
 
 
 def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
@@ -439,7 +484,9 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     # 503, not 200-with-a-sad-body: routers and external
                     # LBs act on status codes, not on parsed payloads
                     self._json(503, h, headers={
-                        "Retry-After": DRAINING_RETRY_AFTER_S,
+                        "Retry-After": retry_after_estimate(
+                            server, floor=DRAINING_RETRY_AFTER_S
+                        ),
                     })
             elif self.path == "/stats":
                 self._json(200, server.stats())
@@ -471,9 +518,56 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 self._json(503, {
                     "error": "replica draining", "state": "draining",
                     "id": rid,
-                }, headers={"Retry-After": DRAINING_RETRY_AFTER_S,
-                            "X-Request-Id": rid})
+                }, headers={
+                    "Retry-After": retry_after_estimate(
+                        server, floor=DRAINING_RETRY_AFTER_S
+                    ),
+                    "X-Request-Id": rid,
+                })
                 return
+            tier = msg.get("tier", "interactive")
+            if tier not in TIERS:
+                self._json(400, {
+                    "error": f"unknown tier {tier!r} (expected one of "
+                             f"{list(TIERS)})",
+                    "id": rid,
+                }, headers={"X-Request-Id": rid})
+                return
+            brownout = server.engine.brownout
+            if brownout is not None and brownout.sheds(tier):
+                # the degradation ladder's explicit rejection: batch sheds
+                # first (429, plain backpressure semantics), interactive
+                # only at the final fail-fast rung (503 — the service is
+                # degraded, not the request). Both carry the live estimate.
+                level = brownout.level_name()
+                server.registry.inc(f"serve/shed_{tier}")
+                server.registry.emit({
+                    "record": "serve_shed",
+                    "id": rid,
+                    "tier": tier,
+                    "level": level,
+                })
+                self._json(429 if tier == "batch" else 503, {
+                    "error": f"brownout ({level}): shedding {tier} traffic",
+                    "brownout": level,
+                    "tier": tier,
+                    "retryable": True,
+                    "id": rid,
+                }, headers={
+                    "Retry-After": retry_after_estimate(
+                        server, floor=BACKPRESSURE_RETRY_AFTER_S
+                    ),
+                    "X-Request-Id": rid,
+                })
+                return
+            max_new = int(
+                msg.get("max_new_tokens", server.queue.max_new_tokens)
+            )
+            if brownout is not None:
+                clamped = brownout.clamp(max_new)
+                if clamped != max_new:
+                    server.registry.inc("serve/brownout_clamped")
+                max_new = clamped
             ids = tokenizer.text_ids(prompt)
             if not ids:
                 self._json(400, {"error": "empty prompt after tokenization",
@@ -509,15 +603,13 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
             try:
                 server.submit(
                     np.asarray(ids, np.int32),
-                    max_new_tokens=int(
-                        msg.get("max_new_tokens",
-                                server.queue.max_new_tokens)
-                    ),
+                    max_new_tokens=max_new,
                     temperature=float(msg.get("temperature", 0.0)),
                     top_k=int(msg.get("top_k", 0)),
                     eot_id=eot_id,
                     seed=int(msg.get("seed", 0)),
                     deadline_s=msg.get("deadline_s"),
+                    tier=tier,
                     stream=on_token,
                     on_finish=on_finish,
                     request_id=rid,
@@ -525,15 +617,23 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
             except BackpressureError as e:
                 # backpressure is retryable BY CONSTRUCTION — say when
                 self._json(429, {"error": str(e), "id": rid},
-                           headers={"Retry-After": BACKPRESSURE_RETRY_AFTER_S,
-                                    "X-Request-Id": rid})
+                           headers={
+                               "Retry-After": retry_after_estimate(
+                                   server, floor=BACKPRESSURE_RETRY_AFTER_S
+                               ),
+                               "X-Request-Id": rid,
+                           })
                 return
             except RuntimeError as e:
                 # submit raced the queue closing: draining, not client error
                 self._json(503, {"error": f"{type(e).__name__}: {e}",
                                  "id": rid},
-                           headers={"Retry-After": DRAINING_RETRY_AFTER_S,
-                                    "X-Request-Id": rid})
+                           headers={
+                               "Retry-After": retry_after_estimate(
+                                   server, floor=DRAINING_RETRY_AFTER_S
+                               ),
+                               "X-Request-Id": rid,
+                           })
                 return
             except ValueError as e:
                 self._json(400, {"error": f"{type(e).__name__}: {e}",

@@ -114,6 +114,7 @@ def summarize(records: list[dict]) -> dict:
         "restarts": len(restarts),
         "serve": summarize_serve(records),
         "fleet": summarize_fleet(records),
+        "storm": summarize_storm(records),
         "swap": summarize_swap(records),
         "guards": guards,
         "locks": summarize_locks(records),
@@ -425,6 +426,115 @@ def summarize_fleet(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_storm(records: list[dict]) -> dict | None:
+    """Fold the load-shaping records (SLO tier lanes + brownout ladder in
+    serve/queue.py, autoscaler + dynamic pool in serve/autoscale.py +
+    serve/fleet.py) into the storm view: per-tier request latency
+    percentiles, shed/brownout counters, and the scale-event timeline
+    (scale-ups with spawn->ready latency, drain-based scale-downs with
+    measured drain time, bind-race port retries). None when the stream
+    holds no tiered/brownout/scale records at all — pre-storm streams
+    keep their old summary shape."""
+    reqs = [
+        r for r in records
+        if r.get("record") == "serve_request" and r.get("tier") is not None
+    ]
+    sheds = [r for r in records if r.get("record") == "serve_shed"]
+    brownouts = [
+        r for r in records if r.get("record") == "brownout_transition"
+    ]
+    scales = [r for r in records if r.get("record") == "fleet_scale"]
+    auto_events = [
+        r for r in records if r.get("record") == "autoscale_event"
+    ]
+    readies = [r for r in records if r.get("record") == "autoscale_ready"]
+    port_retries = [
+        r for r in records if r.get("record") == "replica_port_retry"
+    ]
+    if not (reqs or sheds or brownouts or scales or auto_events):
+        return None
+
+    tiers = {}
+    for tier in sorted({r.get("tier") for r in reqs}):
+        rows = [r for r in reqs if r.get("tier") == tier]
+        done = [r for r in rows if r.get("status") == "done"]
+        tiers[tier] = {
+            "requests": len(rows),
+            "done": len(done),
+            "expired": sum(1 for r in rows if r.get("status") == "expired"),
+            "ttft_s": _pcts([r.get("ttft_s") for r in done]),
+            "total_s": _pcts([r.get("total_s") for r in done]),
+            "queue_wait_s": _pcts([r.get("queue_wait_s") for r in rows]),
+        }
+
+    shed_by_tier: dict[str, int] = {}
+    for r in sheds:
+        tier = r.get("tier") or "?"
+        shed_by_tier[tier] = shed_by_tier.get(tier, 0) + 1
+    peak_level = max((r.get("level", 0) for r in brownouts), default=0)
+    level_names = ("normal", "shed_batch", "clamp", "fail_fast")
+
+    def _level_of(name) -> int:
+        return level_names.index(name) if name in level_names else 0
+
+    # scale-event timeline, oldest first (ts is stamped by the sink)
+    timeline = []
+    for r in scales:
+        timeline.append({
+            "ts": r.get("ts"),
+            "event": f"scale_{r.get('action')}",
+            "replica": r.get("replica"),
+            "size": r.get("size"),
+            **({"drain_s": r.get("drain_s")}
+               if r.get("drain_s") is not None else {}),
+        })
+    for r in readies:
+        timeline.append({
+            "ts": r.get("ts"),
+            "event": "replica_ready",
+            "replica": r.get("replica"),
+            "ready_s": r.get("ready_s"),
+        })
+    for r in port_retries:
+        timeline.append({
+            "ts": r.get("ts"),
+            "event": "port_retry",
+            "replica": r.get("replica"),
+            "new_port": r.get("new_port"),
+        })
+    timeline.sort(key=lambda e: e.get("ts") or 0.0)
+
+    return {
+        "tiers": tiers,
+        "sheds": {
+            "total": len(sheds),
+            "by_tier": shed_by_tier,
+        },
+        "brownout": {
+            "transitions": len(brownouts),
+            "escalations": sum(
+                1 for r in brownouts
+                if r.get("level", 0) > _level_of(r.get("from"))
+            ),
+            "peak_level": peak_level,
+            "final_level": brownouts[-1].get("level") if brownouts else 0,
+        },
+        "scale_ups": sum(
+            1 for r in scales if r.get("action") == "up"
+        ),
+        "scale_downs": sum(
+            1 for r in scales if r.get("action") == "down"
+        ),
+        "scale_up_ready_s": _pcts([r.get("ready_s") for r in readies]),
+        "scale_down_drain_s": _pcts([
+            r.get("drain_s") for r in scales
+            if r.get("action") == "down"
+        ]),
+        "port_retries": len(port_retries),
+        "timeline": timeline,
+    }
+
+
 def summarize_swap(records: list[dict]) -> dict | None:
     """Fold hot-swap records (serve/hotswap.py + the engine's swap
     protocol + the fleet's rolling rollout) into the rollout-health view:
@@ -582,6 +692,77 @@ def render_fleet_table(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def render_storm_table(storm: dict) -> str:
+    """Per-tier latency rows + shed/brownout counters + the scale-event
+    timeline (the load-shaping view of a storm stream)."""
+    def ms(block: dict | None, key: str):
+        return (
+            block[key] * 1e3
+            if block and block.get(key) is not None else None
+        )
+
+    cols = ["tier", "reqs", "done", "expired", "ttft p50 ms",
+            "total p50 ms", "total p95 ms", "total p99 ms",
+            "queue-wait p95 ms"]
+    rows = []
+    for tier in sorted(storm["tiers"]):
+        t = storm["tiers"][tier]
+        rows.append([
+            tier, _fmt(t["requests"]), _fmt(t["done"]), _fmt(t["expired"]),
+            _fmt(ms(t["ttft_s"], "p50")),
+            _fmt(ms(t["total_s"], "p50")), _fmt(ms(t["total_s"], "p95")),
+            _fmt(ms(t["total_s"], "p99")),
+            _fmt(ms(t["queue_wait_s"], "p95")),
+        ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "storm:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    sheds = storm["sheds"]
+    brown = storm["brownout"]
+    shed_detail = ",".join(
+        f"{k}={v}" for k, v in sorted(sheds["by_tier"].items())
+    ) or "-"
+    lines.append(
+        f"sheds={sheds['total']} ({shed_detail})  "
+        f"brownout: transitions={brown['transitions']} "
+        f"peak-level={brown['peak_level']} "
+        f"final-level={brown['final_level']}"
+        + (" [recovered]" if brown["final_level"] == 0 else " [DEGRADED]")
+    )
+    ready = storm.get("scale_up_ready_s") or {}
+    drain = storm.get("scale_down_drain_s") or {}
+    lines.append(
+        f"autoscale: ups={storm['scale_ups']} "
+        f"(ready p95={_fmt(ready.get('p95'))}s) "
+        f"downs={storm['scale_downs']} "
+        f"(drain p95={_fmt(drain.get('p95'))}s) "
+        f"port-retries={storm['port_retries']}"
+    )
+    t0 = next(
+        (e["ts"] for e in storm["timeline"] if e.get("ts") is not None),
+        None,
+    )
+    for e in storm["timeline"]:
+        at = (
+            f"+{e['ts'] - t0:.1f}s" if t0 is not None and e.get("ts")
+            is not None else "?"
+        )
+        extra = "".join(
+            f" {k}={_fmt(e[k], '.3g')}" for k in ("size", "ready_s",
+                                                  "drain_s", "new_port")
+            if e.get(k) is not None
+        )
+        lines.append(f"  {at:>8}  {e['event']:<13} {e['replica']}{extra}")
+    return "\n".join(lines)
+
+
 def render_locks_table(locks: dict, top_n: int = 8) -> str:
     """Top-N locks by contention then hold p99, plus any violations."""
     rows_src = sorted(
@@ -719,6 +900,11 @@ def render_table(summary: dict) -> str:
         if not summary["epochs"] and not serve:
             lines = []  # pure fleet stream: the fleet table IS the output
         lines.append(render_fleet_table(fleet))
+    storm = summary.get("storm")
+    if storm:
+        if not summary["epochs"] and not serve and not fleet:
+            lines = []  # pure storm stream: the storm table IS the output
+        lines.append(render_storm_table(storm))
     swap = summary.get("swap")
     if swap:
         ro = swap.get("rollout_s") or {}

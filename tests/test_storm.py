@@ -1,0 +1,815 @@
+"""Load-shaping tests: trace replay, SLO tier lanes, brownout, autoscaler.
+
+The storm layer (ISSUE 13) is three state machines plus a workload
+generator, and all of them are testable without a single subprocess:
+
+- ``serve/trace.py``: seed-determinism (same config -> identical event
+  list) and the open-loop replay driver under an injected clock;
+- ``serve/queue.py`` tier lanes: weighted round-robin share under
+  contention, work conservation when one lane idles, per-lane no-bypass;
+- ``BrownoutController``: the fixed reversible ladder — batch sheds
+  before ANY interactive rejection, clamps are admission-time (hence
+  reversible), sustained-pressure holds mean a flapping gauge cannot
+  flap the policy, and recovery retraces to zero shedding;
+- ``serve/autoscale.py``: hysteresis + cooldown over a fake fleet with a
+  fake clock — no flapping under an oscillating gauge, bounded pool.
+
+One subprocess drill rides at the end: ``fleet.retire_replica()`` (the
+autoscaler's scale-down path) must complete an in-flight 64-token stream
+through the SIGTERM -> drain -> exit-75 contract — no in-flight request
+dies when capacity leaves the pool.
+"""
+
+import http.client
+import json
+import threading
+import time
+import types
+
+import pytest
+
+from pytorch_distributed_training_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
+)
+from pytorch_distributed_training_tpu.serve.queue import (
+    BROWNOUT_LEVELS,
+    BrownoutController,
+    GenRequest,
+    RequestQueue,
+)
+from pytorch_distributed_training_tpu.serve.server import wait_until
+from pytorch_distributed_training_tpu.serve.trace import (
+    TraceConfig,
+    generate_trace,
+    replay,
+    trace_stats,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.storm]
+
+
+class ListSink:
+    """In-memory telemetry sink (same contract as JsonlSink.emit)."""
+
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        with self._lock:
+            self.records.append(rec)
+
+    def flush(self, **kw):
+        pass
+
+    def of(self, kind):
+        with self._lock:
+            return [r for r in self.records if r.get("record") == kind]
+
+
+def _registry():
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    sink = ListSink()
+    reg.attach_sink(sink)
+    return reg, sink
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# =====================================================================
+# trace generator + replay driver
+# =====================================================================
+
+
+def test_trace_seed_determinism():
+    cfg = TraceConfig(seed=7, duration_s=20.0)
+    a = generate_trace(cfg)
+    b = generate_trace(cfg)
+    assert a == b                       # same seed -> identical trace
+    assert a != generate_trace(TraceConfig(seed=8, duration_s=20.0))
+    assert len(a) > 10
+    # events are schedule-ordered with sane draws
+    for prev, ev in zip(a, a[1:]):
+        assert ev.t_s >= prev.t_s
+    for ev in a:
+        assert ev.tier in ("interactive", "batch")
+        assert cfg.prompt_len_min <= ev.prompt_len <= cfg.prompt_len_max
+        assert (
+            cfg.output_tokens_min
+            <= ev.max_new_tokens
+            <= cfg.output_tokens_max
+        )
+        assert ev.deadline_s == (
+            cfg.interactive_deadline_s
+            if ev.tier == "interactive"
+            else cfg.batch_deadline_s
+        )
+        assert ev.burst == (3.0 <= ev.t_s < 5.0)    # default burst window
+
+
+def test_trace_burst_density_and_stats():
+    cfg = TraceConfig(
+        seed=1, duration_s=12.0, base_rate_rps=2.0, burst_rate_rps=30.0,
+        bursts=((4.0, 2.0),),
+    )
+    events = generate_trace(cfg)
+    stats = trace_stats(events)
+    assert stats["events"] == len(events)
+    assert stats["by_tier"]["interactive"] + stats["by_tier"]["batch"] == (
+        len(events)
+    )
+    # the burst must be visibly denser than the base load: its 2s window
+    # holds more arrivals than the remaining 10s of base-rate traffic
+    burst = [e for e in events if e.burst]
+    assert len(burst) > len(events) - len(burst)
+    assert stats["burst_events"] == len(burst)
+
+
+def test_trace_replay_open_loop_with_injected_clock():
+    cfg = TraceConfig(seed=3, duration_s=5.0)
+    events = generate_trace(cfg)
+    clock = FakeClock(0.0)
+
+    def sleep(dt):
+        clock.t += dt
+
+    fired = []
+    out = replay(
+        events, fired.append, now_fn=clock, sleep_fn=sleep,
+    )
+    assert out["fired"] == len(events) == len(fired)
+    assert fired == events              # in schedule order
+    # a perfectly-sleeping replayer never runs late
+    assert out["max_lag_s"] < 0.06
+    # stop predicate aborts the replay early
+    half = len(events) // 2
+    count = {"n": 0}
+
+    def fire(ev):
+        count["n"] += 1
+
+    clock.t = 0.0
+    out = replay(
+        events, fire, now_fn=clock, sleep_fn=sleep,
+        stop=lambda: count["n"] >= half,
+    )
+    assert out["fired"] == count["n"] <= half + 1
+
+
+# =====================================================================
+# SLO tier lanes (serve/queue.py)
+# =====================================================================
+
+
+def _req(rid, tier, prompt_len=4, max_new=8):
+    import numpy as np
+
+    return GenRequest(
+        id=rid,
+        prompt_ids=np.ones((prompt_len,), np.int32),
+        max_new_tokens=max_new,
+        tier=tier,
+    )
+
+
+def _queue(**kw):
+    kw.setdefault("max_depth", 64)
+    kw.setdefault("prompt_buckets", (8, 16))
+    kw.setdefault("max_new_tokens", 64)
+    return RequestQueue(**kw)
+
+
+def test_tier_lanes_weighted_share_under_contention():
+    q = _queue()    # default 4:1 interactive:batch
+    for i in range(8):
+        q.submit(_req(f"i{i}", "interactive"))
+        q.submit(_req(f"b{i}", "batch"))
+    order = [q.pop_ready().id for _ in range(16)]
+    # first schedule cycle: 4 interactive, then 1 batch
+    assert order[:5] == ["i0", "i1", "i2", "i3", "b0"]
+    assert order[5:10] == ["i4", "i5", "i6", "i7", "b1"]
+    # interactive lane empty -> batch gets EVERY pop (work-conserving)
+    assert order[10:] == ["b2", "b3", "b4", "b5", "b6", "b7"]
+    assert q.depth() == 0 and q.pop_ready() is None
+
+
+def test_tier_lanes_no_bypass_is_per_lane():
+    q = _queue()
+    big = _req("big-batch", "batch")
+    q.submit(big)
+    q.submit(_req("b2", "batch"))
+    q.submit(_req("i1", "interactive"))
+    # reject the batch head (page-blocked): its own lane must NOT bypass
+    # it, but the interactive lane still pops
+    popped = q.pop_ready(accept=lambda r: r.tier != "batch")
+    assert popped.id == "i1"
+    assert q.pop_ready(accept=lambda r: r.tier != "batch") is None
+    assert q.depth_by_tier() == {"interactive": 0, "batch": 2}
+    # unblocked: strict FIFO within the batch lane resumes
+    assert q.pop_ready().id == "big-batch"
+    assert q.pop_ready().id == "b2"
+
+
+def test_tier_validation_and_depth_by_tier():
+    q = _queue()
+    with pytest.raises(ValueError, match="tier"):
+        q.submit(_req("x", "bulk"))
+    q.submit(_req("a", "interactive"))
+    q.submit(_req("b", "batch"))
+    q.submit(_req("c", "batch"))
+    assert q.depth() == 3
+    assert q.depth_by_tier() == {"interactive": 1, "batch": 2}
+
+
+# =====================================================================
+# brownout ladder (serve/queue.py)
+# =====================================================================
+
+
+def test_brownout_escalates_one_level_per_hold_and_recovers():
+    clock = FakeClock()
+    reg, sink = _registry()
+    br = BrownoutController(
+        high_watermark=0.8, low_watermark=0.3,
+        escalate_hold_s=1.0, deescalate_hold_s=2.0,
+        clamp_max_new=8, now_fn=clock, registry=reg,
+    )
+    # sustained overload walks the ladder one level at a time — each level
+    # needs its OWN hold, no skipping straight to fail_fast
+    levels = []
+    for _ in range(8):
+        levels.append(br.observe(0.9))
+        clock.t += 0.55
+    assert levels == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert br.level_name() == "fail_fast"
+    # recovery retraces the ladder down under sustained low pressure
+    down = []
+    for _ in range(14):
+        down.append(br.observe(0.1))
+        clock.t += 1.05
+    assert down[0] == 3 and down[-1] == 0
+    assert sorted(set(down), reverse=True) == [3, 2, 1, 0]
+    assert br.level == 0 and not br.sheds("batch")
+    transitions = [
+        (r["from"], r["to"]) for r in sink.of("brownout_transition")
+    ]
+    assert transitions == [
+        ("normal", "shed_batch"), ("shed_batch", "clamp"),
+        ("clamp", "fail_fast"), ("fail_fast", "clamp"),
+        ("clamp", "shed_batch"), ("shed_batch", "normal"),
+    ]
+
+
+def test_brownout_batch_sheds_before_any_interactive_rejection():
+    """THE degradation-order pin: walking the whole ladder, interactive is
+    rejected ONLY at the final level, and by then batch has been shedding
+    for two levels already."""
+    clock = FakeClock()
+    br = BrownoutController(
+        escalate_hold_s=0.5, deescalate_hold_s=0.5, now_fn=clock,
+    )
+    seen = [(br.level_name(), br.sheds("batch"), br.sheds("interactive"))]
+    while br.level < len(BROWNOUT_LEVELS) - 1:
+        prev = br.level
+        br.observe(1.0)
+        clock.t += 0.6
+        if br.level != prev:
+            seen.append((br.level_name(), br.sheds("batch"),
+                         br.sheds("interactive")))
+    assert seen == [
+        ("normal", False, False),
+        ("shed_batch", True, False),
+        ("clamp", True, False),
+        ("fail_fast", True, True),
+    ]
+
+
+def test_brownout_clamp_is_reversible_and_identity_below_level():
+    clock = FakeClock()
+    br = BrownoutController(
+        escalate_hold_s=0.5, deescalate_hold_s=0.5, clamp_max_new=16,
+        now_fn=clock,
+    )
+    assert br.clamp(64) == 64           # normal: identity
+    while br.level < 2:
+        br.observe(1.0)
+        clock.t += 0.6
+    assert br.clamp(64) == 16 and br.clamp(8) == 8
+    while br.level > 0:
+        br.observe(0.0)
+        clock.t += 0.6
+    assert br.clamp(64) == 64           # recovery lifts the clamp
+
+
+def test_brownout_flapping_gauge_never_moves_the_ladder():
+    clock = FakeClock()
+    br = BrownoutController(
+        escalate_hold_s=1.0, deescalate_hold_s=1.0, now_fn=clock,
+    )
+    # pressure oscillates across the watermarks faster than either hold:
+    # crossing back resets the timers, so the level never moves
+    for i in range(40):
+        br.observe(0.95 if i % 2 == 0 else 0.05)
+        clock.t += 0.4
+    assert br.level == 0
+    assert br.escalations == 0 and br.deescalations == 0
+    # mid-band samples also reset an accumulating hold
+    br.observe(0.95)
+    clock.t += 0.9
+    br.observe(0.5)                     # inside the hysteresis band
+    clock.t += 0.2
+    br.observe(0.95)
+    assert br.level == 0                # the 0.9s above-hold did not carry
+
+
+# =====================================================================
+# autoscaler hysteresis + cooldown (serve/autoscale.py)
+# =====================================================================
+
+
+class FakeFleet:
+    """The exact surface Autoscaler needs: router health views + process
+    states + the two pool knobs. Gauges are set per-test."""
+
+    def __init__(self, n=2):
+        self.retired = []
+        self._n = 0
+        self.router = types.SimpleNamespace(replicas=[])
+        self.replicas = []
+        for _ in range(n):
+            self._add()
+        self.depth = 0.0
+        self.occupancy = 0.0
+
+    def _add(self):
+        name = f"r{self._n}"
+        self._n += 1
+        fleet = self
+
+        class View:
+            def __init__(self):
+                self.name = name
+                self.breaker = types.SimpleNamespace(state="closed")
+
+            @property
+            def health(self):
+                return {
+                    "queue_depth": fleet.depth,
+                    "page_occupancy": fleet.occupancy,
+                }
+
+            def available(self):
+                return True
+
+        self.router.replicas.append(View())
+        proc = types.SimpleNamespace(name=name, state="up")
+        self.replicas.append(proc)
+        return proc
+
+    def scale_up(self):
+        return self._add()
+
+    def retire_replica(self):
+        live = [r for r in self.replicas if r.state in ("starting", "up")]
+        if len(live) <= 1:
+            return None
+        victim = live[-1]
+        self.replicas.remove(victim)
+        self.router.replicas = [
+            v for v in self.router.replicas if v.name != victim.name
+        ]
+        self.retired.append(victim.name)
+        return victim.name
+
+
+def _autoscaler(fleet, clock, **kw):
+    reg, sink = _registry()
+    cfg = AutoscaleConfig(**{
+        "min_replicas": 1, "max_replicas": 4,
+        "scale_up_queue_depth": 6.0, "scale_down_queue_depth": 1.0,
+        "up_hold_s": 1.0, "down_hold_s": 5.0,
+        "up_cooldown_s": 5.0, "down_cooldown_s": 10.0,
+        **kw,
+    })
+    return Autoscaler(fleet, cfg, now_fn=clock, registry=reg), sink
+
+
+def test_autoscaler_never_flaps_under_oscillating_gauge():
+    fleet = FakeFleet(2)
+    clock = FakeClock()
+    auto, _ = _autoscaler(fleet, clock)
+    # queue depth oscillates violently across BOTH thresholds, faster than
+    # either hold: the signal never holds, so the pool never changes
+    for i in range(100):
+        fleet.depth = 20.0 if i % 2 == 0 else 0.0
+        assert auto.step() is None
+        clock.t += 0.6
+    assert len(fleet.replicas) == 2
+    assert auto.scale_ups == 0 and auto.scale_downs == 0
+
+
+def test_autoscaler_scales_up_after_hold_then_cooldown_blocks():
+    fleet = FakeFleet(2)
+    clock = FakeClock()
+    auto, sink = _autoscaler(fleet, clock)
+    fleet.depth = 12.0                  # sustained overload
+    assert auto.step() is None          # onset: hold starts
+    clock.t += 1.1
+    assert auto.step() == "up"          # held past up_hold_s -> act
+    assert len(fleet.replicas) == 3
+    # still overloaded, but the cooldown gates further action; the hold
+    # timer re-accumulates underneath it
+    clock.t += 2.0
+    assert auto.step() is None
+    clock.t += 3.5                      # cooldown expired + hold satisfied
+    assert auto.step() == "up"
+    assert len(fleet.replicas) == 4
+    # at max_replicas: pressure can no longer grow the pool
+    clock.t += 10.0
+    auto.step()
+    clock.t += 1.1
+    assert auto.step() is None
+    assert len(fleet.replicas) == 4
+    events = [r for r in sink.records if r["record"] == "autoscale_event"]
+    assert [e["action"] for e in events] == ["up", "up"]
+
+
+def test_autoscaler_scale_down_waits_longer_and_respects_min():
+    fleet = FakeFleet(3)
+    clock = FakeClock()
+    auto, sink = _autoscaler(fleet, clock)
+    fleet.depth = 0.0                   # idle pool
+    assert auto.step() is None
+    clock.t += 2.0
+    assert auto.step() is None          # 2s < down_hold_s: too early
+    clock.t += 3.5
+    assert auto.step() == "down"        # held 5.5s -> retire newest
+    assert fleet.retired == ["r2"]
+    clock.t += 6.0                      # inside down_cooldown_s (10s)
+    assert auto.step() is None          # cooldown gates; hold re-accumulates
+    clock.t += 5.0                      # cooldown over, idle held 5s through
+    assert auto.step() == "down"
+    assert len(fleet.replicas) == 1
+    # min_replicas floor: an idle pool of one is left alone
+    clock.t += 30.0
+    auto.step()
+    clock.t += 5.5
+    assert auto.step() is None
+    assert len(fleet.replicas) == 1
+
+
+def test_autoscaler_breaker_and_occupancy_signals():
+    fleet = FakeFleet(2)
+    clock = FakeClock()
+    auto, _ = _autoscaler(fleet, clock)
+    # page pressure alone (queue shallow) is a scale-up signal: admission
+    # is about to block on pages
+    fleet.depth = 0.0
+    fleet.occupancy = 0.95
+    auto.step()
+    clock.t += 1.1
+    assert auto.step() == "up"
+    # an open breaker vetoes scale-DOWN even when the queue is idle: a
+    # half-dead pool is not excess capacity
+    fleet.occupancy = 0.0
+    fleet.router.replicas[0].breaker.state = "open"
+    clock.t += 10.0
+    auto.step()
+    clock.t += 6.0
+    assert auto.step() is None
+    # breaker closes -> the idle hold finally acts
+    fleet.router.replicas[0].breaker.state = "closed"
+    auto.step()
+    clock.t += 5.5
+    assert auto.step() == "down"
+
+
+def test_autoscaler_ignores_booting_pool():
+    fleet = FakeFleet(2)
+    clock = FakeClock()
+    auto, _ = _autoscaler(fleet, clock)
+    for view in fleet.router.replicas:
+        view.available = lambda: False      # nothing qualified yet
+    fleet.depth = 50.0
+    for _ in range(20):
+        assert auto.step() is None          # no reading -> no action
+        clock.t += 1.0
+    assert auto.scale_ups == 0
+
+
+# =====================================================================
+# retry-after estimate + port-retry + pool degradation (satellites)
+# =====================================================================
+
+
+def test_retry_after_estimate_is_bounded_and_live():
+    from pytorch_distributed_training_tpu.serve.server import (
+        RETRY_AFTER_CEILING_S,
+        retry_after_estimate,
+    )
+
+    def fake_server(depth, rate):
+        return types.SimpleNamespace(
+            engine=types.SimpleNamespace(drain_rate=rate),
+            queue=types.SimpleNamespace(depth=lambda: depth),
+        )
+
+    # cold engine (no drain history): the floor is the answer
+    assert retry_after_estimate(fake_server(10, 0.0), floor=5) == 5
+    # live estimate: depth / rate, floored and ceilinged
+    assert retry_after_estimate(fake_server(12, 2.0), floor=1) == 6
+    assert retry_after_estimate(fake_server(1, 10.0), floor=5) == 5
+    assert retry_after_estimate(
+        fake_server(10_000, 0.5), floor=1
+    ) == RETRY_AFTER_CEILING_S
+
+
+def test_replica_port_retry_burns_no_restart(monkeypatch):
+    """The find_free_port TOCTOU closure: a bind-race exit (76) respawns
+    on a fresh port INSIDE the attempt — run_with_restarts never sees it,
+    the restart budget stays whole, and the router is told to re-qualify
+    the new address."""
+    from pytorch_distributed_training_tpu.serve import fleet as fleet_mod
+
+    rcs = [fleet_mod.PORT_IN_USE_EXIT_CODE,
+           fleet_mod.PORT_IN_USE_EXIT_CODE, 0]
+    spawned = []
+
+    class FakeProc:
+        def __init__(self, rc):
+            self.pid = 4242
+            self._rc = rc
+
+        def wait(self):
+            return self._rc
+
+        def poll(self):
+            return self._rc
+
+    def fake_popen(argv, env=None, stdout=None, stderr=None):
+        spawned.append(list(argv))
+        return FakeProc(rcs.pop(0))
+
+    monkeypatch.setattr(fleet_mod.subprocess, "Popen", fake_popen)
+    reg, sink = _registry()
+    replica = fleet_mod.ReplicaProcess(
+        0, 50_000, fleet_mod.FleetConfig(num_replicas=1, max_restarts=1),
+        reg,
+    )
+    rebinds = []
+    replica.on_port_change = lambda r: rebinds.append(r.port)
+    replica._spawn_and_wait(0)
+
+    d = replica.describe()
+    assert d["port_retries"] == 2
+    assert d["restarts_used"] == 0          # the race burned NO restart
+    assert d["restart_budget_remaining"] == 1
+    assert len(spawned) == 3
+    assert len(rebinds) == 2 and all(p != 50_000 for p in rebinds)
+    retries = sink.of("replica_port_retry")
+    assert [r["try"] for r in retries] == [1, 2]
+    assert retries[0]["old_port"] == 50_000
+    gauges = reg.snapshot()["counters"]
+    assert gauges.get("fleet/port_retries") == 2
+
+
+def test_pool_status_reports_exhausted_restart_budget():
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+
+    reg, _ = _registry()
+    fleet = ServeFleet(
+        FleetConfig(num_replicas=2, max_restarts=2), registry=reg,
+    )   # constructed, never started: pure state inspection
+    status = fleet.pool_status()
+    assert status["degraded"] is False and status["reason"] is None
+    assert status["restart_budget_remaining"] == {"r0": 2, "r1": 2}
+    # a replica that exhausted its budget degrades the pool, by name
+    fleet.replicas[1].state = "failed"
+    fleet.replicas[1].restarts_used = 2
+    status = fleet.pool_status()
+    assert status["degraded"] is True
+    assert status["failed"] == ["r1"]
+    assert "restart budget exhausted" in status["reason"]
+    assert status["restart_budget_remaining"]["r1"] == 0
+    # the router's fail-fast body folds the same status in
+    assert fleet.router.pool_status() == status
+    fleet.router.close()
+
+
+# =====================================================================
+# summarize_metrics storm section
+# =====================================================================
+
+
+def test_summarize_metrics_storm_section(tmp_path):
+    import subprocess
+    import sys
+
+    records = [
+        {"record": "serve_request", "tier": "interactive", "status": "done",
+         "ttft_s": 0.1, "total_s": 0.5, "queue_wait_s": 0.05, "ts": 1.0},
+        {"record": "serve_request", "tier": "interactive", "status": "done",
+         "ttft_s": 0.2, "total_s": 0.9, "queue_wait_s": 0.30, "ts": 2.0},
+        {"record": "serve_request", "tier": "batch", "status": "done",
+         "ttft_s": 1.0, "total_s": 3.0, "queue_wait_s": 2.00, "ts": 3.0},
+        {"record": "serve_shed", "tier": "batch", "level": 1, "ts": 4.0},
+        {"record": "serve_shed", "tier": "batch", "level": 1, "ts": 4.1},
+        {"record": "serve_shed", "tier": "interactive", "level": 3,
+         "ts": 5.0},
+        {"record": "brownout_transition", "from": "normal",
+         "to": "shed_batch", "level": 1, "pressure": 0.9, "ts": 4.0},
+        {"record": "brownout_transition", "from": "shed_batch",
+         "to": "clamp", "level": 2, "pressure": 0.95, "ts": 4.5},
+        {"record": "brownout_transition", "from": "clamp",
+         "to": "shed_batch", "level": 1, "pressure": 0.1, "ts": 7.0},
+        {"record": "brownout_transition", "from": "shed_batch",
+         "to": "normal", "level": 0, "pressure": 0.05, "ts": 8.0},
+        {"record": "fleet_scale", "action": "up", "replica": "r2",
+         "size": 3, "ts": 5.0},
+        {"record": "autoscale_ready", "replica": "r2", "ready_s": 6.5,
+         "ts": 11.5},
+        {"record": "fleet_scale", "action": "down", "replica": "r2",
+         "drain_s": 1.25, "size": 2, "ts": 20.0},
+        {"record": "replica_port_retry", "replica": "r1",
+         "old_port": 1000, "new_port": 1001, "try": 1, "ts": 2.5},
+    ]
+    stream = tmp_path / "metrics.jsonl"
+    with open(stream, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+    proc = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", str(stream),
+         "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    storm = json.loads(proc.stdout)["storm"]
+    assert storm["tiers"]["interactive"]["requests"] == 2
+    assert storm["tiers"]["batch"]["done"] == 1
+    assert storm["tiers"]["interactive"]["total_s"]["p50"] == 0.5
+    assert storm["sheds"] == {
+        "total": 3, "by_tier": {"batch": 2, "interactive": 1},
+    }
+    assert storm["brownout"]["transitions"] == 4
+    assert storm["brownout"]["escalations"] == 2
+    assert storm["brownout"]["peak_level"] == 2
+    assert storm["brownout"]["final_level"] == 0
+    assert storm["scale_ups"] == 1 and storm["scale_downs"] == 1
+    assert storm["scale_up_ready_s"]["p50"] == 6.5
+    assert storm["scale_down_drain_s"]["p50"] == 1.25
+    assert storm["port_retries"] == 1
+    assert [e["event"] for e in storm["timeline"]] == [
+        "port_retry", "scale_up", "replica_ready", "scale_down",
+    ]
+    # the table renderer accepts the same stream (smoke: no crash)
+    proc = subprocess.run(
+        [sys.executable, "scripts/summarize_metrics.py", str(stream)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "storm:" in proc.stdout and "autoscale:" in proc.stdout
+
+
+# =====================================================================
+# subprocess drill: scale-down drains an in-flight stream via exit 75
+# =====================================================================
+
+
+def test_retire_replica_drains_in_flight_64_token_stream():
+    """The autoscaler's scale-down path end-to-end: ``retire_replica()``
+    SIGTERMs the newest replica while it is mid-way through a 64-token
+    stream; the stream must COMPLETE (drain, not cancel), the exit must be
+    the graceful 75 with a measured drain duration in the ``fleet_scale``
+    record, the router must deregister the endpoint, and no restart is
+    burned anywhere."""
+    from pytorch_distributed_training_tpu.serve.fleet import (
+        FleetConfig,
+        ServeFleet,
+    )
+    from pytorch_distributed_training_tpu.serve.router import RouterConfig
+
+    reg, sink = _registry()
+    fleet = ServeFleet(
+        FleetConfig(
+            num_replicas=2,
+            replica_args=(
+                "--model", "gpt2-tiny", "--num-slots", "2",
+                "--prompt-buckets", "16,32", "--max-new-tokens-cap", "64",
+                "--queue-depth", "16", "--stall-timeout-s", "10",
+            ),
+            max_restarts=1,
+            backoff_s=0.2,
+            drain_timeout_s=20.0,
+        ),
+        RouterConfig(
+            health_interval_s=0.05, breaker_threshold=3,
+            breaker_cooldown_s=0.5, retry_backoff_s=0.02,
+            retry_backoff_max_s=0.1, ttfb_timeout_s=60.0,
+        ),
+        registry=reg,
+    ).start()
+    try:
+        assert fleet.wait_ready(timeout=120), fleet.stats()
+        # retire_replica picks the newest live replica (r1) — stream
+        # straight to ITS port so the request is provably on the retiree
+        target = fleet.replica(1)
+        events = []
+        client_done = threading.Event()
+
+        def client():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", target.port, timeout=120
+                )
+                conn.request(
+                    "POST", "/generate",
+                    body=json.dumps({
+                        "prompt": "a long scale-down drain drill",
+                        "max_new_tokens": 64,
+                        "tier": "interactive",
+                    }),
+                    headers={"X-Request-Id": "retire-64"},
+                )
+                resp = conn.getresponse()
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    events.append(json.loads(line))
+                conn.close()
+            finally:
+                client_done.set()
+
+        threading.Thread(target=client, daemon=True).start()
+        assert wait_until(lambda: len(events) >= 2, timeout=60), events
+        name = fleet.retire_replica()       # SIGTERM mid-stream
+        assert name == "r1"
+
+        # the in-flight stream completes — scale-down kills no request
+        assert client_done.wait(120)
+        done = events[-1]
+        assert done["event"] == "done", events[-3:]
+        assert done["new_tokens"] == 64 and done["status"] == "done"
+
+        # graceful exit 75, no restart burned, drain duration measured
+        assert wait_until(
+            lambda: any(r["replica"] == "r1"
+                        for r in sink.of("replica_exit")),
+            timeout=60,
+        )
+        exit_rec = [
+            r for r in sink.of("replica_exit") if r["replica"] == "r1"
+        ][0]
+        assert exit_rec["graceful"] is True and exit_rec["rc"] == 75
+
+        assert wait_until(
+            lambda: any(r["action"] == "down"
+                        for r in sink.of("fleet_scale")),
+            timeout=60,
+        )
+        down = [r for r in sink.of("fleet_scale") if r["action"] == "down"]
+        assert down[0]["replica"] == "r1" and down[0]["drain_s"] > 0
+
+        # the pool shrank: router deregistered r1, fleet dropped it, and
+        # the retiree did NOT respawn (retirement, not preemption)
+        assert wait_until(
+            lambda: [r.name for r in fleet.router.replicas] == ["r0"],
+            timeout=30,
+        )
+        assert [r.name for r in fleet.replicas] == ["r0"]
+        assert fleet.scale_downs == 1
+        assert fleet.replica(0).describe()["restarts_used"] == 0
+
+        # the survivor still serves
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", fleet.replica(0).port, timeout=60
+        )
+        conn.request(
+            "POST", "/generate",
+            body=json.dumps({"prompt": "post retire", "max_new_tokens": 4}),
+            headers={"X-Request-Id": "post-retire"},
+        )
+        resp = conn.getresponse()
+        lines = resp.read().decode().splitlines()
+        conn.close()
+        assert resp.status == 200
+        assert json.loads(lines[-1])["event"] == "done"
+    finally:
+        fleet.stop(drain=False)
