@@ -725,6 +725,8 @@ def _paged_child(cfg_json: str) -> None:
         page_size=cfg["page_size"], num_pages=cfg["num_pages"],
         spec_k=cfg.get("spec_k", 0),
         prefill_chunk=cfg.get("prefill_chunk", 0),
+        tp=cfg.get("tp", 1),
+        warmup=cfg.get("warmup", False),
     )
     server = InferenceServer(
         model, params, ecfg,
@@ -742,6 +744,11 @@ def _paged_child(cfg_json: str) -> None:
             ).done,
             f"warmup bucket {n}",
         )
+    # the comm audit fires at warmup-compile time (engine-level warmup,
+    # tp mode); grab it before the timing window resets the sink
+    comm_audits = [
+        dict(r) for r in sink.records if r.get("record") == "comm_audit"
+    ]
     sink.records.clear()
 
     work = list(enumerate(prompts))
@@ -812,6 +819,15 @@ def _paged_child(cfg_json: str) -> None:
         "tokens_per_dispatch": stats.get("tokens_per_dispatch"),
         "prefill_chunk": stats.get("prefill_chunk", 0),
         "prefill_chunks": stats.get("prefill_chunks"),
+        "tp": stats.get("tp", 1),
+        # per-tick collective footprint of the hot program, straight from
+        # the compile-time comm audit (tp>1 + warmup only; else empty)
+        "comm_audits": [
+            {k: a.get(k) for k in ("name", "manifest", "ok", "deviations",
+                                   "by_kind", "total_bytes",
+                                   "total_moved_bytes")}
+            for a in comm_audits
+        ],
     }
     print(json.dumps(result))
 
@@ -981,6 +997,100 @@ def run_spec(
         ),
         "streams_identical": len(set(digests.values())) == 1,
         "stream_digests": digests,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+# ------------------------------------------------------------------ tp mode
+# Tensor-parallel serving A/B on CPU: the same closed-loop greedy load
+# through tp=1 and tp=N engines (plus both again with speculation on), all
+# on a forced-multi-device host mesh so sharding is real. The contract is
+# the serve engine's acceptance bar: tp=N must emit BIT-IDENTICAL streams
+# to tp=1 (tensor parallelism is a partitioning knob, not a sampling
+# change), and the tp=N hot program's compile-time comm audit must conform
+# to serve_tp_manifest (exactly 2 all-reduces per layer, bounded bytes, no
+# weight all-gather). Writes BENCH_tp.json; driven by the `perf`+`tp`-
+# marked pytest in tests/test_tp_serve.py, kept out of tier-1.
+
+
+def run_tp(
+    requests: int = 16,
+    concurrency: int = 6,
+    slots: int = 4,
+    max_new: int = 32,
+    tp: int = 2,
+    spec_k: int = 7,
+    page_size: int = 8,
+    queue_depth: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # unlike the other CPU benches (which pop XLA_FLAGS), tp mode NEEDS
+    # virtual devices: every variant — tp=1 included — runs on the same
+    # N-device host so the A/B isolates partitioning, not device count
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={max(tp, 2)}"
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+
+    # same mixed prompt lengths as --spec so digests are comparable across
+    # bench modes; greedy so the identity contract is checkable
+    prompt_mix = [8, 16, 32, 48]
+
+    def one(name: str, **over) -> dict:
+        base = dict(
+            requests=requests, concurrency=concurrency, slots=slots,
+            max_new=max_new, queue_depth=queue_depth, page_size=page_size,
+            num_pages=0, temperature=0.0, top_k=0, prompt_mix=prompt_mix,
+            kv_layout="paged", sampling="device", warmup=True,
+        )
+        base.update(over)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--paged-child", json.dumps(base)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"tp bench variant {name!r} failed "
+                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    tp1 = one("tp1", tp=1)
+    tpn = one("tpN", tp=tp)
+    tp1_spec = one("tp1_spec", tp=1, spec_k=spec_k)
+    tpn_spec = one("tpN_spec", tp=tp, spec_k=spec_k)
+
+    variants = {
+        "tp1": tp1, f"tp{tp}": tpn,
+        "tp1_spec": tp1_spec, f"tp{tp}_spec": tpn_spec,
+    }
+    digests = {n: v["stream_digest"] for n, v in variants.items()}
+    audits = {
+        n: v["comm_audits"] for n, v in variants.items() if v["comm_audits"]
+    }
+    result = {
+        "metric": (
+            f"tensor-parallel serving quick bench (tiny LM, CPU host mesh, "
+            f"tp={tp}, {requests} requests x {max_new} new tokens, "
+            f"{slots} slots, k={spec_k})"
+        ),
+        "tp": tp,
+        "prompt_mix": prompt_mix,
+        **variants,
+        "tokens_per_s_ratio": round(
+            tpn["tokens_per_s"] / tp1["tokens_per_s"], 3
+        ) if tp1["tokens_per_s"] else None,
+        "streams_identical": len(set(digests.values())) == 1,
+        "stream_digests": digests,
+        # every sharded variant's audit must have come back clean
+        "comm_audit_ok": all(
+            a["ok"] for per in audits.values() for a in per
+        ) and bool(audits),
     }
     if out_path:
         with open(out_path, "w") as f:
@@ -2177,6 +2287,28 @@ def main(argv=None):
     p.add_argument("--spec-queue-depth", type=int, default=4)
     p.add_argument("--spec-out", default="BENCH_spec.json",
                    help="where --spec writes its JSON")
+    p.add_argument("--tp", action="store_true",
+                   help="tensor-parallel serving A/B on CPU: tp=1 vs tp=N "
+                        "engines (and both again with speculation) on a "
+                        "forced-multi-device host mesh, same greedy prompt "
+                        "mix; asserts token-identical streams + a clean "
+                        "per-tick comm audit against serve_tp_manifest; "
+                        "writes BENCH_tp.json (no TPU, no probe)")
+    p.add_argument("--tp-n", type=int, default=2,
+                   help="tensor-parallel width for the sharded variants")
+    p.add_argument("--tp-requests", type=int, default=16)
+    p.add_argument("--tp-concurrency", type=int, default=6,
+                   help="closed-loop client threads")
+    p.add_argument("--tp-slots", type=int, default=4,
+                   help="engine decode slots")
+    p.add_argument("--tp-max-new", type=int, default=32)
+    p.add_argument("--tp-spec-k", type=int, default=7,
+                   help="draft tokens per slot in the speculative variants")
+    p.add_argument("--tp-page-size", type=int, default=8,
+                   help="tokens per KV page")
+    p.add_argument("--tp-queue-depth", type=int, default=4)
+    p.add_argument("--tp-out", default="BENCH_tp.json",
+                   help="where --tp writes its JSON")
     p.add_argument("--fleet", action="store_true",
                    help="fleet resilience bench on CPU: 2 supervised "
                         "replicas behind the router, one SIGKILLed "
@@ -2261,6 +2393,20 @@ def main(argv=None):
             page_size=args.spec_page_size,
             queue_depth=args.spec_queue_depth,
             out_path=args.spec_out,
+        )
+        print(json.dumps(result))
+        return result
+    if args.tp:
+        result = run_tp(
+            requests=args.tp_requests,
+            concurrency=args.tp_concurrency,
+            slots=args.tp_slots,
+            max_new=args.tp_max_new,
+            tp=args.tp_n,
+            spec_k=args.tp_spec_k,
+            page_size=args.tp_page_size,
+            queue_depth=args.tp_queue_depth,
+            out_path=args.tp_out,
         )
         print(json.dumps(result))
         return result

@@ -146,16 +146,24 @@ class GuardedCall:
     def warm(self) -> bool:
         return self._warm
 
+    @staticmethod
+    def _aval(a):
+        """Shape/dtype spec of one warm-up operand, KEEPING its
+        NamedSharding: dropping it would re-lower the single-device
+        program, and a tensor-parallel comm audit would then inspect HLO
+        with no collectives at all — a false "required kind absent"."""
+        sh = getattr(a, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
     def _donation_audit_from(self, args, kwargs) -> None:
         """Cheap post-first-compile donation audit: re-lower against the
         warm-up call's avals (shape/dtype metadata stays readable on
         donated buffers; no backend compile, no data touched) and parse
         the aliasing out of the lowering text."""
         try:
-            specs = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                (args, dict(kwargs)),
-            )
+            specs = jax.tree.map(self._aval, (args, dict(kwargs)))
             lowered = self.fn.lower(*specs[0], **specs[1])
         except Exception as e:  # pragma: no cover - lowering quirk
             self.guards.registry.emit({
@@ -179,10 +187,7 @@ class GuardedCall:
         )
 
         try:
-            specs = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                (args, dict(kwargs)),
-            )
+            specs = jax.tree.map(self._aval, (args, dict(kwargs)))
             compiled = self.fn.lower(*specs[0], **specs[1]).compile()
         except Exception as e:  # pragma: no cover - lowering quirk
             self.guards.registry.emit({
@@ -347,10 +352,14 @@ class GuardSet:
 
 # ---------------------------------------------------------------- donation
 
-# lowering text marks donated params with tf.aliasing_output; compiled HLO
-# carries an input_output_alias map with one (may|must)-alias entry each
+# lowering text marks donated params with tf.aliasing_output — or, when
+# inputs carry explicit shardings (the tensor-parallel serve programs),
+# with jax.buffer_donor: aliasing is then decided at compile time, and the
+# donor annotation is the lowering-level proof donation survived. Compiled
+# HLO carries an input_output_alias map with one (may|must)-alias entry.
 _ALIAS_PATTERNS = (
     re.compile(r"tf\.aliasing_output"),
+    re.compile(r"jax\.buffer_donor"),
     re.compile(r"(?:may|must)[-_]alias"),
 )
 

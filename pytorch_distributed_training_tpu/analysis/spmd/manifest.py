@@ -48,6 +48,9 @@ class CommManifest:
     allowed: tuple = ()
     required: tuple = ()
     max_bytes: Optional[int] = None
+    # ceiling on ring-model bytes moved per device (CostModel.moved_bytes
+    # summed over all collectives) — the wire-traffic twin of max_bytes
+    max_moved_bytes: Optional[int] = None
 
     def __post_init__(self):
         for kind in tuple(self.allowed) + tuple(self.required):
@@ -80,6 +83,14 @@ class CommManifest:
                 f"total payload {summary['total_bytes']}B exceeds "
                 f"manifest ceiling {self.max_bytes}B"
             )
+        if (
+            self.max_moved_bytes is not None
+            and summary.get("total_moved_bytes", 0) > self.max_moved_bytes
+        ):
+            deviations.append(
+                f"total moved {summary['total_moved_bytes']}B exceeds "
+                f"manifest moved-bytes ceiling {self.max_moved_bytes}B"
+            )
         return deviations
 
     def to_record(self) -> dict:
@@ -88,6 +99,7 @@ class CommManifest:
             "allowed": list(self.allowed),
             "required": list(self.required),
             "max_bytes": self.max_bytes,
+            "max_moved_bytes": self.max_moved_bytes,
         }
 
 
@@ -131,6 +143,53 @@ def serve_manifest(num_devices: int = 1,
     if num_devices <= 1:
         return CommManifest(name, allowed=())
     return CommManifest(name, allowed=COLLECTIVE_KINDS)
+
+
+def serve_tp_manifest(
+    num_devices: int,
+    *,
+    layers: int,
+    hidden: int,
+    max_q_tokens: int,
+    dtype_bytes: int = 4,
+    name: str = "serve_tp",
+    slack: float = 4.0,
+    cost_model: Optional[CostModel] = None,
+) -> CommManifest:
+    """The head-sharded serve engine's pinned contract: each layer's
+    row-parallel attention-out and mlp_down matmuls combine their partial
+    sums with exactly one all-reduce over the replicated ``[tokens,
+    hidden]`` activation — so a program may contain ONLY all-reduces, MUST
+    contain at least one (a "sharded" engine with none silently
+    replicated its weights), and its total payload is bounded by ``2 *
+    layers`` activation-sized reductions (slack absorbs dtype/fusion
+    noise). An all-gather of weights is caught twice over: the kind is
+    not allowed, and gathering even one projection would blow the
+    activation-sized ceiling by orders of magnitude. ``max_q_tokens`` is
+    the widest token block a dispatch scores — ``slots * (spec_k + 1)``
+    for the verify program, ``slots`` for plain decode. The moved-bytes
+    ceiling prices the same budget through the ring
+    :class:`~pytorch_distributed_training_tpu.analysis.spmd.hlo.CostModel`
+    (2·B·(g−1)/g per all-reduce)."""
+    if num_devices <= 1:
+        return CommManifest(name, allowed=())
+    from pytorch_distributed_training_tpu.analysis.spmd.hlo import (
+        Collective,
+    )
+
+    payload = 2 * layers * max_q_tokens * hidden * dtype_bytes
+    cm = cost_model if cost_model is not None else CostModel()
+    moved = cm.moved_bytes(Collective(
+        name=name, kind="all-reduce", dtype="f32", bytes=payload,
+        group_size=num_devices, line=0, asynchronous=False,
+    ))
+    return CommManifest(
+        name,
+        allowed=("all-reduce",),
+        required=("all-reduce",),
+        max_bytes=int(slack * payload),
+        max_moved_bytes=int(slack * moved),
+    )
 
 
 def comm_audit(

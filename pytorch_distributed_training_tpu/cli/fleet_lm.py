@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sampling", default="device",
                    choices=("device", "host"),
                    help="replica sampling mode (see serve_lm)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel width per replica: each replica "
+                        "subprocess spans this many devices (heads + MLP "
+                        "hidden sharded over a model-axis mesh; see "
+                        "serve_lm --tp); on CPU the coordinator grants "
+                        "each replica N virtual devices via XLA_FLAGS")
     p.add_argument("--guards", default=None,
                    choices=("off", "record", "strict"),
                    help="runtime guard + lock-discipline mode, forwarded "
@@ -181,6 +187,7 @@ def main(argv=None) -> dict:
             "record": "fleet_meta",
             "replicas": args.replicas,
             "model": args.model,
+            "tp": args.tp,
             "num_slots": args.num_slots,
             "max_restarts": args.max_restarts,
             "hedge_s": args.hedge_s,
@@ -199,6 +206,22 @@ def main(argv=None) -> dict:
         "--sampling", args.sampling,
         "--guards", guard_mode,
     ]
+    replica_env = {}
+    if args.tp > 1:
+        replica_args += ["--tp", str(args.tp)]
+        import os
+
+        # the coordinator stays jax-free, so backend detection is by env:
+        # on the host platform each replica subprocess needs its own
+        # N-device view, which means forcing virtual devices into the
+        # child's XLA runtime (appended so operator-set flags survive)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            flags = (os.environ.get("XLA_FLAGS", "") +
+                     f" --xla_force_host_platform_device_count={args.tp}")
+            replica_env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": flags.strip(),
+            }
     if args.lock_summary_s > 0:
         replica_args += ["--lock-summary-s", str(args.lock_summary_s)]
     if args.interactive_deadline_s > 0:
@@ -232,6 +255,7 @@ def main(argv=None) -> dict:
             num_replicas=args.replicas,
             replica_args=tuple(replica_args),
             replica_extra_args=extra_args,
+            replica_env=replica_env,
             max_restarts=args.max_restarts,
             restart_window_s=args.restart_window_s,
             drain_timeout_s=args.drain_timeout_s,

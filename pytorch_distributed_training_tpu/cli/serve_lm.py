@@ -94,6 +94,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "compiled program (0 = one jitted prefill per "
                         "bucket); long prompts stop monopolising the tick "
                         "loop and new buckets stop triggering compiles")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor parallelism: shard THIS replica's engine "
+                        "over N devices (attention heads + MLP hidden on "
+                        "a model-axis mesh, paged KV pools split on the "
+                        "head dim; streams stay bit-identical to tp=1). "
+                        "Requires paged KV + device sampling and a model "
+                        "whose num_heads/intermediate_size divide by N")
     p.add_argument("--warmup", action="store_true",
                    help="compile every prefill bucket + the decode step "
                         "before serving (first request pays no compile; "
@@ -228,6 +235,7 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             "spec_k": args.spec_k,
             "spec_draft": spec_draft if args.spec_k > 0 else None,
             "prefill_chunk": args.prefill_chunk,
+            "tp": args.tp,
         })
 
     config = EngineConfig(
@@ -244,6 +252,7 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         spec_k=args.spec_k,
         spec_draft=spec_draft,
         prefill_chunk=args.prefill_chunk,
+        tp=args.tp,
     )
     from pytorch_distributed_training_tpu.analysis.concurrency import (
         get_lock_registry,

@@ -70,19 +70,43 @@ def paged_attention(
             f"q must be [batch, heads, head_dim] or "
             f"[batch, q_len, heads, head_dim], got {q.shape}"
         )
+    pool_axes = ("num_pages", "page_size", "heads", "head_dim")
     if k_pages.shape != v_pages.shape:
+        bad = ", ".join(
+            f"{name} (axis {i}): k_pages={ks} vs v_pages={vs}"
+            for i, (name, ks, vs) in enumerate(
+                zip(pool_axes, k_pages.shape, v_pages.shape)
+            )
+            if ks != vs
+        ) or f"rank: k_pages={k_pages.ndim} vs v_pages={v_pages.ndim}"
         raise ValueError(
-            f"k_pages/v_pages shapes differ: {k_pages.shape} vs {v_pages.shape}"
+            f"k_pages/v_pages shapes differ on {bad} "
+            f"(full shapes {k_pages.shape} vs {v_pages.shape})"
         )
+    # q's trailing [heads, head_dim] must match the pools — the axis pair
+    # that goes wrong first when heads shard over a tensor-parallel mesh
+    # and one side of the call still sees the unsharded width
+    for name, q_dim, pool_dim in (
+        ("heads", q.shape[-2], k_pages.shape[2]),
+        ("head_dim", q.shape[-1], k_pages.shape[3]),
+    ):
+        if q_dim != pool_dim:
+            raise ValueError(
+                f"q/pool mismatch on axis {name!r}: q has {q_dim}, "
+                f"k_pages/v_pages have {pool_dim} (q {q.shape}, pools "
+                f"{k_pages.shape})"
+            )
     if block_table.ndim != 2 or block_table.shape[0] != q.shape[0]:
         raise ValueError(
-            f"block_table must be [batch, pages_per_seq], got "
-            f"{block_table.shape} for batch {q.shape[0]}"
+            f"block_table must be [batch, pages_per_seq]: got shape "
+            f"{block_table.shape} (rank {block_table.ndim}, want 2; axis "
+            f"'batch' got {block_table.shape[0] if block_table.ndim else '-'}"
+            f", want {q.shape[0]} from q)"
         )
     if lengths.shape != (q.shape[0],):
         raise ValueError(
-            f"lengths must be [batch], got {lengths.shape} for batch "
-            f"{q.shape[0]}"
+            f"lengths must be [batch]: got shape {lengths.shape}, want "
+            f"({q.shape[0]},) (axis 'batch' from q)"
         )
     if q.ndim == 4:
         if impl == "reference":

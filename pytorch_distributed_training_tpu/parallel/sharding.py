@@ -144,6 +144,39 @@ def param_pspecs(params, policy: ShardingPolicy, mesh: Mesh):
     )
 
 
+def serve_param_shardings(params, mesh: Mesh,
+                          policy: ShardingPolicy | None = None):
+    """NamedSharding pytree for a SERVING params tree on a tensor-parallel
+    mesh: the Megatron TP rules above (QKV column-parallel on heads,
+    attention-out / mlp_down row-parallel) applied to the inference
+    weights, everything else — embeddings, norms, lm head — replicated.
+    No fsdp: a serve replica wants whole layers resident, not gathered
+    per tick."""
+    policy = policy if policy is not None else ShardingPolicy(tp=True)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, _leaf_spec(path, leaf, policy, mesh)
+        ),
+        params,
+    )
+
+
+def serve_pool_pspec() -> P:
+    """PartitionSpec for one paged-KV pool leaf ``[num_pages, page_size,
+    heads, head_dim]``: heads shard over ``model`` so each shard owns its
+    own page pool at 1/N width — page indices, block tables and the
+    allocator arithmetic are untouched (they address the page axis, which
+    stays whole)."""
+    return P(None, None, "model", None)
+
+
+def serve_pool_shardings(pools, mesh: Mesh):
+    """NamedSharding pytree for the engine's paged K/V pools (every leaf
+    is a ``[num_pages, page_size, heads, head_dim]`` pool)."""
+    spec = serve_pool_pspec()
+    return jax.tree.map(lambda _: NamedSharding(mesh, spec), pools)
+
+
 def state_shardings(state: TrainState, policy: ShardingPolicy, mesh: Mesh):
     """NamedSharding pytree for the full TrainState.
 

@@ -111,6 +111,7 @@ from pytorch_distributed_training_tpu.analysis.guards import (
 )
 from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
     serve_manifest,
+    serve_tp_manifest,
 )
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
 from pytorch_distributed_training_tpu.serve.paged_cache import (
@@ -182,6 +183,12 @@ class EngineConfig:
     # DEFERRED (transient queue hold, not page exhaustion) until a
     # streaming prompt finishes.
     prefill_concurrency: int = 1
+    # Tensor parallelism: the engine's jitted programs run under pjit over
+    # a `model`-axis mesh of this many devices, attention heads + MLP
+    # hidden sharded (parallel/sharding.py serve rules), paged pools split
+    # on the head dim. 1 = today's single-device engine, bit-identical
+    # streams either way. Requires kv_layout="paged" + sampling="device".
+    tp: int = 1
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -233,6 +240,16 @@ class EngineConfig:
                 raise ValueError(
                     "spec_k/prefill_chunk require sampling='device'"
                 )
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp > 1:
+            # sharding rides the paged multi-token-query programs and the
+            # in-jit sampler (one replicated [slots] int32 D2H per tick);
+            # the dense/host baselines stay single-device by design
+            if self.kv_layout != "paged":
+                raise ValueError("tp > 1 requires kv_layout='paged'")
+            if self.sampling != "device":
+                raise ValueError("tp > 1 requires sampling='device'")
         if self.kv_layout == "paged" and self.num_pages > 0:
             if self.num_pages < self.pages_per_slot + 1:
                 raise ValueError(
@@ -260,6 +277,21 @@ class EngineConfig:
         if self.num_pages > 0:
             return self.num_pages
         return self.num_slots * self.pages_per_slot + 1
+
+
+def _check_tp_divisible(cfg, tp: int, role: str) -> None:
+    """Head-sharding feasibility: the model axis splits attention heads
+    and the MLP hidden dim into equal slices, so both must divide."""
+    for axis, size in (
+        ("num_heads", cfg.num_heads),
+        ("intermediate_size", cfg.intermediate_size),
+    ):
+        if size % tp:
+            raise ValueError(
+                f"tp={tp} does not divide {role} model's {axis}={size} — "
+                f"attention heads and the MLP hidden dim shard over the "
+                f"model axis, so each shard needs an equal slice"
+            )
 
 
 def _patch_index_vars(cache, value):
@@ -359,6 +391,43 @@ class DecodeEngine:
                 f"(speculative drafts occupy positions past the committed "
                 f"context before acceptance is known)"
             )
+        # Tensor-parallel mesh (tp > 1): every jitted program below runs
+        # under pjit over a `model`-axis mesh — params shard by the serve
+        # rules (heads / MLP hidden), pools shard on the head dim, and all
+        # host-built operands are placed REPLICATED through self._put (a
+        # device-0-committed operand mixed with mesh-sharded params is a
+        # placement error, not a resharding).
+        self._mesh = None
+        self._param_shardings = None
+        self._pool_sharding = None
+        self._repl = None
+        if config.tp > 1:
+            from pytorch_distributed_training_tpu.comms.mesh import (
+                MeshConfig,
+                build_mesh,
+            )
+
+            _check_tp_divisible(cfg, config.tp, "model")
+            devices = jax.devices()
+            if len(devices) < config.tp:
+                raise ValueError(
+                    f"tp={config.tp} needs {config.tp} devices, have "
+                    f"{len(devices)}"
+                )
+            self._mesh = build_mesh(
+                MeshConfig(data=1, fsdp=1, stage=1, model=config.tp, seq=1),
+                devices=devices[: config.tp],
+            )
+            self._repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec()
+            )
+            from pytorch_distributed_training_tpu.parallel.sharding import (
+                serve_pool_pspec,
+            )
+
+            self._pool_sharding = jax.sharding.NamedSharding(
+                self._mesh, serve_pool_pspec()
+            )
         paged = config.kv_layout == "paged"
         dcfg = dataclasses.replace(cfg, decode=True, kv_layout=config.kv_layout)
         if paged:
@@ -425,11 +494,33 @@ class DecodeEngine:
                 self._draft_mq_model = type(draft_model)(
                     dataclasses.replace(ddcfg, paged_multiquery=True)
                 )
-            self._draft_params = jax.device_put(draft_params)
+            if self._mesh is not None:
+                _check_tp_divisible(dmc, config.tp, "draft")
+                from pytorch_distributed_training_tpu.parallel.sharding import (  # noqa: E501
+                    serve_param_shardings,
+                )
+
+                self._draft_params = jax.device_put(
+                    draft_params,
+                    serve_param_shardings(draft_params, self._mesh),
+                )
+            else:
+                self._draft_params = jax.device_put(draft_params)
         # explicit placement: restored checkpoints arrive as host arrays,
         # and a host tree reaching the warm compiled calls would be an
-        # implicit per-tick H2D (a strict-mode transfer violation)
-        self._params = jax.device_put(params)
+        # implicit per-tick H2D (a strict-mode transfer violation). Under
+        # tp the placement IS the sharding: weights shard at load, and
+        # every later swap re-places onto the same shardings so the warm
+        # programs never see a new input layout (no retrace).
+        if self._mesh is not None:
+            from pytorch_distributed_training_tpu.parallel.sharding import (
+                serve_param_shardings,
+            )
+
+            self._param_shardings = serve_param_shardings(params, self._mesh)
+            self._params = jax.device_put(params, self._param_shardings)
+        else:
+            self._params = jax.device_put(params)
         self._queue = queue
         # live weight-swap state: version served, one pending (validated,
         # device-placed) replacement, and the trial window's keep-alive of
@@ -473,6 +564,13 @@ class DecodeEngine:
             self._cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), strip_tables(shapes)
             )
+            if self._pool_sharding is not None:
+                # pools split on the head dim (each shard owns its own
+                # 1/N-width page pool); the page axis stays whole so the
+                # allocator's block-table arithmetic is untouched
+                self._cache = jax.device_put(
+                    self._cache, self._pool_sharding
+                )
             self._pages = PageAllocator(
                 config.total_pages, config.page_size,
                 config.pages_per_slot, config.num_slots,
@@ -489,6 +587,10 @@ class DecodeEngine:
                     lambda s: jnp.zeros(s.shape, s.dtype),
                     strip_tables(dshapes),
                 )
+                if self._pool_sharding is not None:
+                    self._draft_cache = jax.device_put(
+                        self._draft_cache, self._pool_sharding
+                    )
         else:
             # Per-slot cache template comes from a batch-1 abstract init at
             # the full cache length (no params materialized); the resident
@@ -548,18 +650,52 @@ class DecodeEngine:
 
     # -------------------------------------------------------------- compiled
 
+    def _put(self, tree):
+        """ONE explicit H2D for host-built operands. Single-device: plain
+        ``device_put``. Tensor-parallel: committed REPLICATED onto the
+        mesh — every program input must live on all the mesh's devices
+        (params/pools sharded, operands replicated), or dispatch would
+        mix device-0-committed arrays with mesh-committed ones."""
+        if self._repl is None:
+            return jax.device_put(tree)
+        return jax.device_put(tree, self._repl)
+
+    @property
+    def param_shardings(self):
+        """Per-leaf NamedShardings of the serving params (None when
+        tp == 1): hot-swap loaders ``device_put`` replacement trees onto
+        exactly these so a live swap keeps the compiled programs' input
+        layouts (no retrace, no implicit reshard)."""
+        return self._param_shardings
+
     def _serve_manifest(self, name: str):
-        """Expected-collective manifest for one serve program: today's
-        engine is single-device by construction (no mesh), so the pinned
-        contract is ZERO collectives. The audit costs one extra compile
-        per program, so only the steady-state hot program of a warmed
-        engine is audited — the single-token decode step, or the verify
-        program when speculation replaces it — and the per-bucket/chunk
-        prefills share its partitioning story (and already carry
-        donation audits). Tests that skip warmup skip the manifest too."""
+        """Expected-collective manifest for one serve program. The
+        single-device engine (tp=1, no mesh) pins ZERO collectives; the
+        tensor-parallel engine pins exactly the head-sharding contract —
+        all-reduce only, all-reduce REQUIRED, payload ceiling of 2
+        activation-sized reductions per layer from the ring cost model
+        (``serve_tp_manifest``), so a silently replicated weight (no
+        collectives) and a weight all-gather (wrong kind + ceiling blown)
+        both fail the audit. The audit costs one extra compile per
+        program, so only the steady-state hot program of a warmed engine
+        is audited — the single-token decode step, or the verify program
+        when speculation replaces it — and the per-bucket/chunk prefills
+        share its partitioning story (and already carry donation audits).
+        Tests that skip warmup skip the manifest too."""
         hot = "serve_verify" if self.config.spec_k > 0 else "serve_decode"
         if not self.config.warmup or name != hot:
             return None
+        if self.config.tp > 1:
+            mcfg = self._decode_model.config
+            q = 1 + (self.config.spec_k if name == "serve_verify" else 0)
+            return serve_tp_manifest(
+                self.config.tp,
+                layers=mcfg.num_layers,
+                hidden=mcfg.hidden_size,
+                max_q_tokens=self.config.num_slots * q,
+                dtype_bytes=jnp.dtype(mcfg.compute_dtype).itemsize,
+                name=name,
+            )
         return serve_manifest(1, name=name)
 
     def _prefill_fn(self, bucket: int):
@@ -927,7 +1063,7 @@ class DecodeEngine:
         outs = []
         if paged and cfg.prefill_chunk > 0:
             # ONE chunk program replaces the whole per-bucket prefill set
-            ops = jax.device_put((
+            ops = self._put((
                 np.zeros((1, cfg.prefill_chunk), np.int32),
                 np.zeros((1,), np.int32),
                 np.int32(0),
@@ -939,7 +1075,7 @@ class DecodeEngine:
             )
             outs.append(out)
             if draft:
-                dops = jax.device_put((
+                dops = self._put((
                     np.zeros((1, cfg.prefill_chunk), np.int32),
                     np.zeros((1,), np.int32),
                     np.zeros((1, W), np.int32),
@@ -950,14 +1086,14 @@ class DecodeEngine:
         else:
             for bucket in cfg.prompt_buckets:
                 if paged:
-                    ops = jax.device_put((
+                    ops = self._put((
                         np.zeros((1, bucket), np.int32),
                         np.int32(1),
                         np.zeros((1, W), np.int32),
                         np.int32(0), np.float32(0.0), np.int32(0),
                     ))
                 else:
-                    ops = jax.device_put((
+                    ops = self._put((
                         np.int32(0),
                         np.zeros((1, bucket), np.int32),
                         np.int32(1),
@@ -968,7 +1104,7 @@ class DecodeEngine:
                 )
                 outs.append(out)
                 if draft:
-                    dops = jax.device_put((
+                    dops = self._put((
                         np.zeros((1, bucket), np.int32),
                         np.zeros((1, W), np.int32),
                     ))
@@ -978,7 +1114,7 @@ class DecodeEngine:
         S = cfg.num_slots
         if paged and cfg.spec_k > 0:
             # verify replaces the single-token decode step entirely
-            ops = jax.device_put((
+            ops = self._put((
                 np.zeros((S, cfg.spec_k + 1), np.int32),
                 np.zeros((S, W), np.int32),
                 np.zeros((S,), np.int32),
@@ -990,7 +1126,7 @@ class DecodeEngine:
             )
             outs.append(out)
             if draft:
-                dops = jax.device_put((
+                dops = self._put((
                     np.zeros((S,), np.int32),
                     np.zeros((S, W), np.int32),
                     np.zeros((S,), np.int32),
@@ -1001,7 +1137,7 @@ class DecodeEngine:
                 outs.append(dout)
         else:
             if paged:
-                ops = jax.device_put((
+                ops = self._put((
                     np.zeros((S,), np.int32),
                     np.zeros((S, W), np.int32),
                     np.zeros((S,), np.int32),
@@ -1009,7 +1145,7 @@ class DecodeEngine:
                     np.zeros((S,), np.float32), np.zeros((S,), np.int32),
                 ))
             else:
-                ops = jax.device_put((
+                ops = self._put((
                     np.zeros((S,), np.int32),
                     np.zeros((S,), bool),
                     np.zeros((S,), np.int32), np.zeros((S,), np.int32),
@@ -1090,7 +1226,14 @@ class DecodeEngine:
         can't serve under the running model (nothing is queued) and
         ``RuntimeError`` while another swap is still in flight."""
         self._validate_swap(params)
-        placed = jax.device_put(params)
+        # tp: re-place onto the SAME per-leaf shardings the warm programs
+        # were compiled against — a replicated (or device-0) replacement
+        # tree would change the compiled input layouts and retrace
+        placed = (
+            jax.device_put(params, self._param_shardings)
+            if self._param_shardings is not None
+            else jax.device_put(params)
+        )
         with self._swap_lock:
             if self._pending_swap is not None:
                 raise RuntimeError(
@@ -1110,7 +1253,11 @@ class DecodeEngine:
         kept alive until ``_commit_swap`` (first clean post-swap tick)."""
         self._validate_swap(params)
         prev_params, prev_version = self._params, self.weights_step
-        self._params = jax.device_put(params)
+        self._params = (
+            jax.device_put(params, self._param_shardings)
+            if self._param_shardings is not None
+            else jax.device_put(params)
+        )
         self.weights_step = version
         self._trial = (prev_params, prev_version, ticket)
         self._registry.inc("serve/swaps_applied")
@@ -1363,13 +1510,13 @@ class DecodeEngine:
                 np.int32(min(req.top_k, np.iinfo(np.int32).max)),
             )
             if paged:
-                ops = jax.device_put((
+                ops = self._put((
                     padded,
                     np.int32(req.prompt_len),
                     self._pages.block_table[slot : slot + 1],
                 ) + sample_ops)
             else:
-                ops = jax.device_put((
+                ops = self._put((
                     np.int32(slot),
                     padded,
                     np.int32(req.prompt_len),
@@ -1382,7 +1529,7 @@ class DecodeEngine:
                     # mirror the prompt into the draft pools (same block-
                     # table row, draft-side K/V) so the draft lane shares
                     # the slot's committed context from its first tick
-                    dops = jax.device_put((
+                    dops = self._put((
                         padded,
                         self._pages.block_table[slot : slot + 1],
                     ))
@@ -1447,7 +1594,7 @@ class DecodeEngine:
                 np.int32(req.prompt_len - 1 - start) if is_last
                 else np.int32(0)
             )
-            ops = jax.device_put((
+            ops = self._put((
                 ids,
                 np.asarray([start], np.int32),
                 sample_idx,
@@ -1461,7 +1608,7 @@ class DecodeEngine:
                     self._params, self._cache, *ops
                 )
                 if self._draft_model is not None:
-                    dops = jax.device_put((
+                    dops = self._put((
                         ids,
                         np.asarray([start], np.int32),
                         self._pages.block_table[i : i + 1],
@@ -1551,15 +1698,15 @@ class DecodeEngine:
             # the loop), and the k proposals come back in ONE device_get.
             # Dispatch 0's output is discarded — it only resyncs the
             # draft cache at ctx-1; dispatch 1 feeds the pending token.
-            bt_d = jax.device_put(bt)
-            feed = jax.device_put(toks)
+            bt_d = self._put(bt)
+            feed = self._put(toks)
             for j in range(k + 1):
                 out, self._draft_cache = fn(
                     self._draft_params, self._draft_cache, feed,
-                    bt_d, jax.device_put(ctx),
+                    bt_d, self._put(ctx),
                 )
                 outs.append(out)
-                feed = jax.device_put(pending) if j == 0 else out
+                feed = self._put(pending) if j == 0 else out
                 ctx = ctx + inc
             proposals = np.stack(jax.device_get(outs[1:]), axis=1)
         for i in spec_slots:
@@ -1614,7 +1761,7 @@ class DecodeEngine:
             temps[i] = r.temperature
             top_ks[i] = min(r.top_k, np.iinfo(np.int32).max)
             bt[i] = self._pages.block_table[i]
-        ops = jax.device_put(
+        ops = self._put(
             (tokens, bt, ctx, seeds, steps0, temps, top_ks)
         )
         with watchdog_guard("serve_decode"):
@@ -1802,9 +1949,9 @@ class DecodeEngine:
                         bt[i] = self._pages.block_table[i]
                 else:
                     bt = self._pages.block_table
-                ops = jax.device_put((tokens, bt, ctx) + sample_ops)
+                ops = self._put((tokens, bt, ctx) + sample_ops)
             else:
-                ops = jax.device_put((tokens, mask) + sample_ops)
+                ops = self._put((tokens, mask) + sample_ops)
             with watchdog_guard("serve_decode"):
                 out, self._cache = self._decode_step_fn()(
                     self._params, self._cache, *ops
@@ -1912,6 +2059,7 @@ class DecodeEngine:
             "compiled_prefill_buckets": sorted(self._prefill_fns),
             "kv_layout": self.config.kv_layout,
             "sampling": self.config.sampling,
+            "tp": self.config.tp,
             "kv_page_size": self.config.page_size if paged else None,
             "kv_pages_total": self._pages.num_pages - 1 if paged else None,
             "kv_pages_used": self._pages.pages_used if paged else None,

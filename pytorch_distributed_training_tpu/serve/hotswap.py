@@ -323,7 +323,8 @@ class CheckpointWatcher:
 # ------------------------------------------------------------------ loading
 
 
-def load_swap_params(directory: str, step: int, *, current_params):
+def load_swap_params(directory: str, step: int, *, current_params,
+                     shardings=None):
     """Load the params subtree of checkpoint ``step`` for a live swap.
 
     Partial restore against ``current_params``' structure when layouts
@@ -332,6 +333,12 @@ def load_swap_params(directory: str, step: int, *, current_params):
     Leaves are explicitly placed on device — the engine's strict transfer
     guard treats an implicit per-tick H2D as a violation, so the one
     legitimate transfer happens HERE, once, off the serve loop.
+
+    ``shardings`` (a per-leaf NamedSharding tree, the tensor-parallel
+    engine's ``param_shardings``) places each leaf straight onto its
+    shard layout, so the swap hands the engine a tree in exactly the
+    layout its warm programs were compiled against — no retrace, no
+    resharding copy on the serve loop.
 
     Raises on any load problem (missing step, corrupt array, structure
     mismatch) — the caller maps that to swap_failed + rollback.
@@ -364,6 +371,8 @@ def load_swap_params(directory: str, step: int, *, current_params):
         params = restore_params(
             directory, params_like=current_params, step=step
         )
+    if shardings is not None:
+        return jax.device_put(params, shardings)
     return jax.device_put(params)
 
 
@@ -460,6 +469,7 @@ class HotSwapManager:
             params = load_swap_params(
                 self.checkpoint_dir, step,
                 current_params=engine.params,
+                shardings=getattr(engine, "param_shardings", None),
             )
         except Exception as e:
             return self._fail(step, "load", e)

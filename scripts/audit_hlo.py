@@ -20,6 +20,11 @@ Usage:
   --max-bytes N               # payload-bytes ceiling for --check
   --fusions                   # also print one representative instruction
                               # per named-fusion family (dump_hlo's job)
+  --serve-tp N                # compile the tensor-parallel serve programs
+                              # (paged decode + spec verify, tiny LM) over
+                              # an N-way model-axis mesh and audit each
+                              # against serve_tp_manifest; same --json /
+                              # --check contract as the train-step audit
 """
 
 import argparse
@@ -60,6 +65,9 @@ def _parse_args(argv):
                    help="comma-separated allowed collective kinds")
     p.add_argument("--max-bytes", type=int, default=None)
     p.add_argument("--fusions", action="store_true")
+    p.add_argument("--serve-tp", type=int, default=None,
+                   help="audit the tensor-parallel serve programs over an "
+                        "N-way model-axis mesh instead of the train step")
     return p.parse_args(argv)
 
 
@@ -75,8 +83,111 @@ def _fusion_families(txt):
     return fams
 
 
+def _serve_tp_audit(args):
+    """Compile-and-audit the sharded serve programs standalone.
+
+    Builds the tiny-LM paged serve engine twice (spec off -> hot program
+    is ``serve_decode``; spec on -> ``serve_verify``) at ``--serve-tp N``
+    with warmup on, which compiles each hot program under the tensor-
+    parallel mesh and runs the production compile-time comm audit against
+    ``serve_tp_manifest``. The audit records ARE the report — the same
+    code path a serving replica runs, not a re-implementation."""
+    tp = args.serve_tp
+    # the audit must REPORT deviations (and let --check set the exit
+    # code), not die on the strict guard's first violation
+    os.environ["PDT_TPU_GUARDS"] = "record"
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={tp}"
+            ).strip()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.models.gpt2 import GPT2LMModel
+    from pytorch_distributed_training_tpu.serve import (
+        EngineConfig,
+        InferenceServer,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        MetricsRegistry,
+    )
+    from pytorch_distributed_training_tpu.utils.config import model_preset
+
+    if jax.device_count() < tp:
+        raise SystemExit(
+            f"--serve-tp {tp} needs {tp} devices, have "
+            f"{jax.device_count()} (on CPU set JAX_PLATFORMS=cpu so the "
+            f"script can force virtual devices)"
+        )
+
+    class _Sink:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    mcfg = model_preset(
+        "gpt2-tiny", compute_dtype="float32", attention_impl="reference",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPT2LMModel(mcfg)
+    params = model.init(
+        jax.random.key(0), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+
+    audits = []
+    for spec_k in (0, 3):
+        registry = MetricsRegistry()
+        sink = _Sink()
+        registry.attach_sink(sink)
+        # construction alone compiles + audits: warmup=True runs every
+        # bucket and the hot decode/verify program before any request
+        InferenceServer(
+            model, params,
+            EngineConfig(
+                num_slots=2, prompt_buckets=(8,), max_new_tokens=8,
+                kv_layout="paged", sampling="device", page_size=4,
+                spec_k=spec_k, warmup=True, tp=tp,
+            ),
+            queue_depth=2, registry=registry,
+        )
+        audits += [
+            r for r in sink.records if r.get("record") == "comm_audit"
+        ]
+
+    ok = bool(audits) and all(a["ok"] for a in audits)
+    if args.json:
+        print(json.dumps({"serve_tp": tp, "ok": ok, "audits": audits},
+                         indent=2, default=str))
+    else:
+        for a in audits:
+            print(f"{a['name']}: "
+                  f"{sum(s['count'] for s in a['by_kind'].values())} "
+                  f"collectives ({a['total_bytes']} payload B, "
+                  f"{a['total_moved_bytes']} moved B)")
+            for kind, slot in sorted(a["by_kind"].items()):
+                print(f"  {kind:20s} x{slot['count']:<4d} "
+                      f"{slot['bytes']:>12d} B payload  "
+                      f"{slot['moved_bytes']:>12d} B moved")
+            verdict = "CONFORMS" if a["ok"] else "DEVIATES"
+            print(f"manifest {a['manifest']!r}: {verdict}")
+            for d in a.get("deviations", ()):
+                print(f"  - {d}")
+        if not audits:
+            print("no comm_audit records emitted (unexpected)")
+    if args.check and not ok:
+        return 1
+    return 0
+
+
 def main(argv=None):
     args = _parse_args(argv)
+    if args.serve_tp:
+        return _serve_tp_audit(args)
     manifest = None
     if args.hlo_file:
         with open(args.hlo_file) as f:
