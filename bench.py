@@ -714,6 +714,30 @@ def _paged_child(cfg_json: str) -> None:
         for i in range(n_requests)
     ]
 
+    from pytorch_distributed_training_tpu.ops.quant import (
+        dequantize_serve_params,
+        quantize_serve_params,
+    )
+
+    # quality probe BEFORE any grid snapping: max |logit| drift between the
+    # pristine fp32 weights and their int8 round-trip on one prompt — the
+    # bench's quantization-error headline (engines below see snapped or
+    # quantized weights, where the drift is zero by construction)
+    max_logit_drift = None
+    if cfg.get("logit_probe"):
+        probe = jnp.asarray(prompts[0])[None, :]
+        base_logits = model.apply({"params": params}, probe)
+        rt = dequantize_serve_params(quantize_serve_params(params))
+        max_logit_drift = float(jnp.max(jnp.abs(
+            model.apply({"params": rt}, probe) - base_logits
+        )))
+    # snap fp32 weights onto the int8 grid so a FP32 engine and an int8
+    # engine run numerically identical matmul weights — the token-identity
+    # A/B for weight-only quantization (idempotent: snapping an already
+    # snapped tree is a no-op)
+    if cfg.get("snap"):
+        params = dequantize_serve_params(quantize_serve_params(params))
+
     registry = MetricsRegistry()
     sink = _ListSink()
     registry.attach_sink(sink)
@@ -727,6 +751,8 @@ def _paged_child(cfg_json: str) -> None:
         prefill_chunk=cfg.get("prefill_chunk", 0),
         tp=cfg.get("tp", 1),
         warmup=cfg.get("warmup", False),
+        weights_dtype=cfg.get("weights_dtype", "float32"),
+        kv_dtype=cfg.get("kv_dtype", "float32"),
     )
     server = InferenceServer(
         model, params, ecfg,
@@ -791,9 +817,33 @@ def _paged_child(cfg_json: str) -> None:
 
     serve_summary = _serve_stats_mod().summarize_serve(sink.records)
     stats = server.stats()
+
+    # resident bytes of the attention/MLP projection weights in the dtype
+    # the ENGINE holds them — the weight-only-int8 memory headline (the
+    # embedding/LN leaves stay fp32 in every variant and are excluded so
+    # the tiny model's vocab table doesn't mask the matmul-weight ratio)
+    from pytorch_distributed_training_tpu.ops.quant import (
+        _SERVE_QUANT_MODULES,
+    )
+    resident = (
+        quantize_serve_params(params)
+        if cfg.get("weights_dtype", "float32") == "int8" else params
+    )
+    matmul_weight_bytes = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(resident):
+        names = {getattr(k, "key", None) for k in path}
+        if names & set(_SERVE_QUANT_MODULES):
+            matmul_weight_bytes += int(leaf.size) * leaf.dtype.itemsize
+
     result = {
         "kv_layout": cfg["kv_layout"],
         "sampling": cfg["sampling"],
+        "weights_dtype": stats.get("weights_dtype", "float32"),
+        "kv_dtype": stats.get("kv_dtype", "float32"),
+        "variant": stats.get("variant", "fp32"),
+        "kv_bytes_per_token": stats.get("kv_bytes_per_token"),
+        "matmul_weight_bytes": matmul_weight_bytes,
+        "max_logit_drift": max_logit_drift,
         "prompt_mix": mix,
         "tokens_per_s": round(serve_summary["tokens"] / wall, 2),
         "wall_s": round(wall, 3),
@@ -1091,6 +1141,126 @@ def run_tp(
         "comm_audit_ok": all(
             a["ok"] for per in audits.values() for a in per
         ) and bool(audits),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+# ---------------------------------------------------------------- int8 mode
+# Quantized-serving quality/throughput matrix on CPU: the same closed-loop
+# greedy load through fp32 / weight-only-int8 / weight+KV-int8 engines
+# (and the full-int8 engine again with speculation on), all paged+device.
+# Weights are pre-snapped onto the int8 grid so weight-only quantization
+# is provably LOSSLESS — the fp32 and weight-int8 engines must emit
+# bit-identical streams (same sha256 digest) while the int8 engine holds
+# its projection weights at ~0.27x the bytes. Int8 KV is lossy by design;
+# its contract is capacity, priced by a pool-bytes-matched A/B: at the
+# SAME pool byte budget the int8 layout holds >= 1.9x the pages (so
+# >= 1.9x concurrent contexts), demonstrated by serving 2x the slots out
+# of the matched-bytes int8 pool with zero page-exhausted rejections.
+# Writes BENCH_int8.json; driven by the `perf`-marked pytest in
+# tests/test_quant_serve.py, kept out of tier-1.
+
+
+def run_int8(
+    requests: int = 16,
+    concurrency: int = 6,
+    slots: int = 4,
+    max_new: int = 32,
+    spec_k: int = 7,
+    page_size: int = 8,
+    queue_depth: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("HF_HUB_OFFLINE", "1")
+    env.setdefault("HF_DATASETS_OFFLINE", "1")
+
+    # same mixed prompt lengths as --spec/--tp so digests are comparable
+    # across bench modes; greedy so the identity contract is checkable
+    prompt_mix = [8, 16, 32, 48]
+
+    def one(name: str, **over) -> dict:
+        base = dict(
+            requests=requests, concurrency=concurrency, slots=slots,
+            max_new=max_new, queue_depth=queue_depth, page_size=page_size,
+            num_pages=0, temperature=0.0, top_k=0, prompt_mix=prompt_mix,
+            kv_layout="paged", sampling="device", snap=True,
+        )
+        base.update(over)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--paged-child", json.dumps(base)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"int8 bench variant {name!r} failed "
+                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    fp32 = one("fp32", logit_probe=True)
+    w8 = one("weight_int8", weights_dtype="int8")
+    w8kv8 = one("weight_kv_int8", weights_dtype="int8", kv_dtype="int8")
+    w8kv8_spec = one("weight_kv_int8_spec", weights_dtype="int8",
+                     kv_dtype="int8", spec_k=spec_k)
+
+    # pool-bytes-matched capacity A/B: price the fp32 pool that exactly
+    # covers the closed-loop worst case, then give the int8 engine the
+    # SAME byte budget in int8 pages and make it serve 2x the slots
+    longest = max(prompt_mix) + max_new
+    pages_per_ctx = -(-longest // page_size)
+    fp32_pages = slots * pages_per_ctx
+    pool_bytes = fp32_pages * page_size * fp32["kv_bytes_per_token"]
+    int8_pages = pool_bytes // (page_size * w8kv8["kv_bytes_per_token"])
+    contexts_ratio = int8_pages / fp32_pages
+    cap_slots = 2 * slots
+    fp32_cap = one("fp32_kv_capacity", num_pages=fp32_pages)
+    int8_cap = one("int8_kv_capacity", weights_dtype="int8",
+                   kv_dtype="int8", num_pages=int(int8_pages),
+                   slots=cap_slots, concurrency=cap_slots)
+
+    variants = {
+        "fp32": fp32, "weight_int8": w8, "weight_kv_int8": w8kv8,
+        "weight_kv_int8_spec": w8kv8_spec,
+        "fp32_kv_capacity": fp32_cap, "int8_kv_capacity": int8_cap,
+    }
+    result = {
+        "metric": (
+            f"int8 serving quality/throughput matrix (tiny LM, CPU, "
+            f"{requests} requests x {max_new} new tokens, {slots} slots, "
+            f"k={spec_k}, page {page_size} tok)"
+        ),
+        "prompt_mix": prompt_mix,
+        **variants,
+        # weight-only int8 is lossless on the snapped grid: identical
+        # streams at a fraction of the resident projection-weight bytes
+        "weight_only_streams_identical": (
+            fp32["stream_digest"] == w8["stream_digest"]
+        ),
+        "tokens_per_s_ratio_weight_only": round(
+            w8["tokens_per_s"] / fp32["tokens_per_s"], 3
+        ) if fp32["tokens_per_s"] else None,
+        "weight_bytes_ratio": round(
+            w8["matmul_weight_bytes"] / fp32["matmul_weight_bytes"], 3
+        ),
+        "max_logit_drift": fp32["max_logit_drift"],
+        # int8-KV capacity at matched pool bytes
+        "kv_pool_bytes": int(pool_bytes),
+        "kv_contexts_ratio": round(contexts_ratio, 3),
+        "kv_capacity_slots": {"fp32": slots, "int8": cap_slots},
+        "kv_capacity_page_exhausted": {
+            "fp32": fp32_cap["page_exhausted"],
+            "int8": int8_cap["page_exhausted"],
+        },
+        "stream_digests": {
+            n: v["stream_digest"] for n, v in variants.items()
+        },
     }
     if out_path:
         with open(out_path, "w") as f:
@@ -2309,6 +2479,28 @@ def main(argv=None):
     p.add_argument("--tp-queue-depth", type=int, default=4)
     p.add_argument("--tp-out", default="BENCH_tp.json",
                    help="where --tp writes its JSON")
+    p.add_argument("--int8", action="store_true",
+                   help="quantized-serving matrix on CPU: fp32 vs weight-"
+                        "only-int8 vs weight+KV-int8 engines (and full "
+                        "int8 with speculation) under the same greedy "
+                        "load; asserts weight-only token identity on the "
+                        "snapped grid, ~0.27x resident projection-weight "
+                        "bytes, and >=1.9x concurrent contexts from a "
+                        "pool-bytes-matched int8 KV pool; writes "
+                        "BENCH_int8.json (no TPU, no probe)")
+    p.add_argument("--int8-requests", type=int, default=16)
+    p.add_argument("--int8-concurrency", type=int, default=6,
+                   help="closed-loop client threads")
+    p.add_argument("--int8-slots", type=int, default=4,
+                   help="engine decode slots (capacity variant serves 2x)")
+    p.add_argument("--int8-max-new", type=int, default=32)
+    p.add_argument("--int8-spec-k", type=int, default=7,
+                   help="draft tokens per slot in the speculative variant")
+    p.add_argument("--int8-page-size", type=int, default=8,
+                   help="tokens per KV page")
+    p.add_argument("--int8-queue-depth", type=int, default=4)
+    p.add_argument("--int8-out", default="BENCH_int8.json",
+                   help="where --int8 writes its JSON")
     p.add_argument("--fleet", action="store_true",
                    help="fleet resilience bench on CPU: 2 supervised "
                         "replicas behind the router, one SIGKILLed "
@@ -2407,6 +2599,19 @@ def main(argv=None):
             page_size=args.tp_page_size,
             queue_depth=args.tp_queue_depth,
             out_path=args.tp_out,
+        )
+        print(json.dumps(result))
+        return result
+    if args.int8:
+        result = run_int8(
+            requests=args.int8_requests,
+            concurrency=args.int8_concurrency,
+            slots=args.int8_slots,
+            max_new=args.int8_max_new,
+            spec_k=args.int8_spec_k,
+            page_size=args.int8_page_size,
+            queue_depth=args.int8_queue_depth,
+            out_path=args.int8_out,
         )
         print(json.dumps(result))
         return result

@@ -155,6 +155,7 @@ def serve_tp_manifest(
     name: str = "serve_tp",
     slack: float = 4.0,
     cost_model: Optional[CostModel] = None,
+    weight_bytes_floor: Optional[int] = None,
 ) -> CommManifest:
     """The head-sharded serve engine's pinned contract: each layer's
     row-parallel attention-out and mlp_down matmuls combine their partial
@@ -171,6 +172,13 @@ def serve_tp_manifest(
     ceiling prices the same budget through the ring
     :class:`~pytorch_distributed_training_tpu.analysis.spmd.hlo.CostModel`
     (2·B·(g−1)/g per all-reduce)."""
+    # ``weight_bytes_floor`` makes the ceiling dtype-aware end to end: an
+    # int8-weight replica passes the bytes of its SMALLEST sharded
+    # projection, and the ceiling is clamped strictly below payload +
+    # floor, so a program that all-reduced (or gathered) even one weight
+    # matrix on top of its activations breaks the contract at compile
+    # time — slack can no longer mask a quantized engine silently
+    # communicating fp32-sized (or any weight-sized) tensors.
     if num_devices <= 1:
         return CommManifest(name, allowed=())
     from pytorch_distributed_training_tpu.analysis.spmd.hlo import (
@@ -178,6 +186,9 @@ def serve_tp_manifest(
     )
 
     payload = 2 * layers * max_q_tokens * hidden * dtype_bytes
+    max_bytes = int(slack * payload)
+    if weight_bytes_floor is not None:
+        max_bytes = min(max_bytes, payload + int(weight_bytes_floor) - 1)
     cm = cost_model if cost_model is not None else CostModel()
     moved = cm.moved_bytes(Collective(
         name=name, kind="all-reduce", dtype="f32", bytes=payload,
@@ -187,7 +198,7 @@ def serve_tp_manifest(
         name,
         allowed=("all-reduce",),
         required=("all-reduce",),
-        max_bytes=int(slack * payload),
+        max_bytes=max_bytes,
         max_moved_bytes=int(slack * moved),
     )
 

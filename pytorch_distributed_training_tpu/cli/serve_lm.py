@@ -101,6 +101,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "head dim; streams stay bit-identical to tp=1). "
                         "Requires paged KV + device sampling and a model "
                         "whose num_heads/intermediate_size divide by N")
+    p.add_argument("--weights-dtype", default="float32",
+                   choices=("float32", "int8"),
+                   help="serving weight precision: int8 quantizes every "
+                        "attention/MLP matmul weight at load (per-channel "
+                        "scales, dequantized in-trace — activations and "
+                        "logits stay fp32) at ~0.5x resident weight bytes")
+    p.add_argument("--kv-dtype", default="float32",
+                   choices=("float32", "int8"),
+                   help="paged KV cache precision: int8 pools + fp32 "
+                        "per-page-per-head scales beside the block tables "
+                        "(~0.3x KV bytes/token at head_dim 16; allocator "
+                        "and admission arithmetic unchanged). Requires "
+                        "--kv-layout paged")
     p.add_argument("--warmup", action="store_true",
                    help="compile every prefill bucket + the decode step "
                         "before serving (first request pays no compile; "
@@ -236,6 +249,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             "spec_draft": spec_draft if args.spec_k > 0 else None,
             "prefill_chunk": args.prefill_chunk,
             "tp": args.tp,
+            "weights_dtype": args.weights_dtype,
+            "kv_dtype": args.kv_dtype,
         })
 
     config = EngineConfig(
@@ -253,6 +268,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         spec_draft=spec_draft,
         prefill_chunk=args.prefill_chunk,
         tp=args.tp,
+        weights_dtype=args.weights_dtype,
+        kv_dtype=args.kv_dtype,
     )
     from pytorch_distributed_training_tpu.analysis.concurrency import (
         get_lock_registry,

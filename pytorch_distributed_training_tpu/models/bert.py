@@ -40,6 +40,7 @@ from pytorch_distributed_training_tpu.ops.attention import (
 )
 from pytorch_distributed_training_tpu.ops.dropout import Dropout
 from pytorch_distributed_training_tpu.ops.paged_attention import paged_attention
+from pytorch_distributed_training_tpu.ops.quant import quantize_kv
 from pytorch_distributed_training_tpu.utils.config import ModelConfig
 
 
@@ -263,18 +264,39 @@ class BertSelfAttention(nn.Module):
         batch, chunk, heads, head_dim = q.shape
         page_size = cfg.kv_page_size
         is_init = not self.has_variable("cache", "k_pages")
+        # int8 pool storage: pages quantize on write (symmetric absmax over
+        # head_dim) against fp32 scale pools [num_pages, page_size, heads]
+        # that live beside the block tables in the same cache node, so the
+        # engine's with_tables/strip_tables walk, donation and sharding all
+        # carry them automatically. Reads dequantize in-kernel
+        # (ops/paged_attention.py); the allocator never sees dtypes.
+        quant_kv = cfg.kv_cache_dtype == "int8"
+        pool_dtype = jnp.int8 if quant_kv else k.dtype
         kp = self.variable(
             "cache", "k_pages",
             lambda: jnp.zeros(
-                (cfg.kv_num_pages, page_size, heads, head_dim), k.dtype
+                (cfg.kv_num_pages, page_size, heads, head_dim), pool_dtype
             ),
         )
         vp = self.variable(
             "cache", "v_pages",
             lambda: jnp.zeros(
-                (cfg.kv_num_pages, page_size, heads, head_dim), v.dtype
+                (cfg.kv_num_pages, page_size, heads, head_dim), pool_dtype
             ),
         )
+        if quant_kv:
+            ks = self.variable(
+                "cache", "k_scales",
+                lambda: jnp.zeros(
+                    (cfg.kv_num_pages, page_size, heads), jnp.float32
+                ),
+            )
+            vs = self.variable(
+                "cache", "v_scales",
+                lambda: jnp.zeros(
+                    (cfg.kv_num_pages, page_size, heads), jnp.float32
+                ),
+            )
         # Placeholder shapes only: the engine always supplies real
         # block_table/context_len values per call (serve/paged_cache.py
         # with_tables); they are never engine-resident.
@@ -295,8 +317,25 @@ class BertSelfAttention(nn.Module):
         )
         page_ids = jnp.take_along_axis(bt.value, pos // page_size, axis=1)
         offs = pos % page_size
-        kp.value = kp.value.at[page_ids, offs].set(k.astype(kp.value.dtype))
-        vp.value = vp.value.at[page_ids, offs].set(v.astype(vp.value.dtype))
+        if quant_kv:
+            # quantize-on-write: the scale entries scatter through the SAME
+            # (page, offset) indices as their values, so a token's int8
+            # lanes and its fp32 scales can never drift apart
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            kp.value = kp.value.at[page_ids, offs].set(kq)
+            vp.value = vp.value.at[page_ids, offs].set(vq)
+            ks.value = ks.value.at[page_ids, offs].set(ksc)
+            vs.value = vs.value.at[page_ids, offs].set(vsc)
+            pool_kw = dict(k_scales=ks.value, v_scales=vs.value)
+        else:
+            kp.value = kp.value.at[page_ids, offs].set(
+                k.astype(kp.value.dtype)
+            )
+            vp.value = vp.value.at[page_ids, offs].set(
+                v.astype(vp.value.dtype)
+            )
+            pool_kw = {}
         cl.value = idx + chunk
         scale = head_dim ** -0.5
         if chunk == 1:
@@ -307,7 +346,7 @@ class BertSelfAttention(nn.Module):
                 )
             out = paged_attention(
                 q[:, 0], kp.value, vp.value, bt.value, idx + 1,
-                scale=scale, impl=cfg.paged_attention_impl,
+                scale=scale, impl=cfg.paged_attention_impl, **pool_kw,
             )
             return out[:, None]
         if cfg.paged_multiquery:
@@ -322,14 +361,17 @@ class BertSelfAttention(nn.Module):
                 )
             return paged_attention(
                 q, kp.value, vp.value, bt.value, idx + chunk,
-                scale=scale, impl=cfg.paged_attention_impl,
+                scale=scale, impl=cfg.paged_attention_impl, **pool_kw,
             )
         # Prefill: fresh sequence (idx == 0 by engine contract), so the
         # visible context IS this chunk — attend intra-chunk with the exact
         # dense-cache formula (fp32 scores, finfo.min mask, fp32 softmax)
-        # so paged prefill stays bitwise against the dense path.
-        kc = k.astype(kp.value.dtype)
-        vc = v.astype(vp.value.dtype)
+        # so paged prefill stays bitwise against the dense path. Under int8
+        # pools the fresh K/V stays in compute dtype here (only the STORED
+        # pages quantize), so prefill logits — and the first sampled token —
+        # are exact whatever the pool dtype.
+        kc = k if quant_kv else k.astype(kp.value.dtype)
+        vc = v if quant_kv else v.astype(vp.value.dtype)
         scores = jnp.einsum(
             "bsnd,btnd->bnst", q, kc, preferred_element_type=jnp.float32
         ) * scale

@@ -51,6 +51,49 @@ from pytorch_distributed_training_tpu.ops.flash_attention import _interpreting
 _NEG_INF = jnp.finfo(jnp.float32).min
 
 
+_SCALE_AXES = ("num_pages", "page_size", "heads")
+
+
+def _check_scale_pool(pool_name, pool, scale_name, scales):
+    """Trace-time contract between an int8 page pool and its scale pool,
+    in the named-axis error style: int8 pools REQUIRE fp32 scales of shape
+    [num_pages, page_size, heads]; float pools must not carry scales."""
+    if pool.dtype == jnp.int8:
+        want = pool.shape[:3]
+        if scales is None:
+            raise ValueError(
+                f"{pool_name} is int8 but {scale_name} is missing: int8 "
+                f"pools require fp32 per-page-per-head scales of shape "
+                f"(num_pages, page_size, heads) = {want}"
+            )
+        if scales.ndim != 3:
+            raise ValueError(
+                f"{scale_name} must be [num_pages, page_size, heads]: got "
+                f"shape {scales.shape} (rank {scales.ndim}, want 3)"
+            )
+        if tuple(scales.shape) != want:
+            bad = ", ".join(
+                f"{name} (axis {i}): got {g}, want {w}"
+                for i, (name, g, w) in enumerate(
+                    zip(_SCALE_AXES, scales.shape, want)
+                )
+                if g != w
+            )
+            raise ValueError(
+                f"{scale_name} shape mismatch on {bad} (got {scales.shape},"
+                f" want {want} from {pool_name})"
+            )
+        if scales.dtype != jnp.float32:
+            raise ValueError(
+                f"{scale_name} must be float32, got {scales.dtype}"
+            )
+    elif scales is not None:
+        raise ValueError(
+            f"{scale_name} provided but {pool_name} dtype is "
+            f"{pool.dtype}: scale pools accompany int8 pages only"
+        )
+
+
 def paged_attention(
     q: jax.Array,
     k_pages: jax.Array,
@@ -60,11 +103,17 @@ def paged_attention(
     *,
     scale: float,
     impl: str = "reference",
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """Attention through a page table. 3-D ``q`` is the single-token decode
     step (returns [batch, heads, head_dim]); 4-D ``q`` is a causal
     multi-token query block (returns [batch, q_len, heads, head_dim]).
-    Output dtype is ``v_pages.dtype`` (the dense path's output dtype)."""
+    Output dtype is ``v_pages.dtype`` (the dense path's output dtype) —
+    except for int8 pools, whose output is fp32 (the dequantized compute
+    dtype). int8 pools carry fp32 ``k_scales``/``v_scales`` pools of shape
+    [num_pages, page_size, heads]; both impls dequantize in-kernel
+    (``page.astype(f32) * scale`` per head lane)."""
     if q.ndim not in (3, 4):
         raise ValueError(
             f"q must be [batch, heads, head_dim] or "
@@ -108,27 +157,52 @@ def paged_attention(
             f"lengths must be [batch]: got shape {lengths.shape}, want "
             f"({q.shape[0]},) (axis 'batch' from q)"
         )
+    if k_pages.dtype != v_pages.dtype:
+        raise ValueError(
+            f"k_pages/v_pages dtypes differ: {k_pages.dtype} vs "
+            f"{v_pages.dtype} (pools quantize together or not at all)"
+        )
+    _check_scale_pool("k_pages", k_pages, "k_scales", k_scales)
+    _check_scale_pool("v_pages", v_pages, "v_scales", v_scales)
+    scales = (k_scales, v_scales)
     if q.ndim == 4:
         if impl == "reference":
             return _paged_reference_mq(
-                q, k_pages, v_pages, block_table, lengths, scale
+                q, k_pages, v_pages, block_table, lengths, scale, *scales
             )
         if impl == "pallas":
             return _paged_pallas_mq(
-                q, k_pages, v_pages, block_table, lengths, scale
+                q, k_pages, v_pages, block_table, lengths, scale, *scales
             )
         raise ValueError(f"unknown paged attention impl {impl!r}")
     if impl == "reference":
-        return _paged_reference(q, k_pages, v_pages, block_table, lengths, scale)
+        return _paged_reference(
+            q, k_pages, v_pages, block_table, lengths, scale, *scales
+        )
     if impl == "pallas":
-        return _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale)
+        return _paged_pallas(
+            q, k_pages, v_pages, block_table, lengths, scale, *scales
+        )
     raise ValueError(f"unknown paged attention impl {impl!r}")
 
 
 # ---------------------------------------------------------------- reference
 
 
-def _paged_reference(q, k_pages, v_pages, block_table, lengths, scale):
+def _gather_dequant(pages, scales, block_table, batch, tokens, heads,
+                    head_dim):
+    """Gather pages through the block table ([B, W, P, H, D] → [B, T, H, D])
+    and, for int8 pools, dequantize against the identically-gathered scale
+    pool (one fp32 scale per token per head)."""
+    x = pages[block_table].reshape(batch, tokens, heads, head_dim)
+    if scales is None:
+        return x
+    s = scales[block_table].reshape(batch, tokens, heads)
+    return x.astype(jnp.float32) * s[..., None]
+
+
+def _paged_reference(q, k_pages, v_pages, block_table, lengths, scale,
+                     k_scales=None, v_scales=None):
     batch, heads, head_dim = q.shape
     _, page_size, _, _ = k_pages.shape
     windows = block_table.shape[1]
@@ -136,8 +210,13 @@ def _paged_reference(q, k_pages, v_pages, block_table, lengths, scale):
     # Gather the full (padded) context per sequence: [B, W, P, H, D] →
     # [B, W*P, H, D]. Token order is page order × in-page offset, which is
     # exactly how serve/paged_cache.py lays tokens out.
-    k = k_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
-    v = v_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+    tokens = windows * page_size
+    k = _gather_dequant(
+        k_pages, k_scales, block_table, batch, tokens, heads, head_dim
+    )
+    v = _gather_dequant(
+        v_pages, v_scales, block_table, batch, tokens, heads, head_dim
+    )
 
     # Same contraction/softmax formula as the dense cache attend (fp32
     # scores, finfo.min mask, fp32 softmax, probs cast to V dtype) so the
@@ -162,15 +241,19 @@ def _paged_kernel(
     q_ref,  # [1, H, D]
     k_ref,  # [1, P, H, D] — the page selected by index_map for this step
     v_ref,  # [1, P, H, D]
-    o_ref,  # [1, H, D]
-    m_ref,  # VMEM [H, LANES] f32 — running max (broadcast across lanes)
-    l_ref,  # VMEM [H, LANES] f32 — running denominator
-    acc_ref,  # VMEM [H, D] f32 — running numerator
-    *,
+    *refs,  # [ks_ref, vs_ref (int8 pools only)], o_ref, m/l/acc scratch
     scale: float,
     page_size: int,
     windows: int,
+    quantized: bool,
 ):
+    if quantized:
+        # ks/vs: [1, P, H] fp32 — the scale page walked in lockstep with
+        # its K/V page through the same block-table index_map
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     w = pl.program_id(1)
     length = len_ref[b]
@@ -188,6 +271,10 @@ def _paged_kernel(
         q = q_ref[0].astype(jnp.float32)  # [H, D]
         k = k_ref[0].astype(jnp.float32)  # [P, H, D]
         v = v_ref[0].astype(jnp.float32)  # [P, H, D]
+        if quantized:
+            # in-kernel dequant: one fp32 scale per (token, head) lane
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
 
         # [H, P]: batch over heads (q dim 0 / k dim 1), contract head_dim.
         s = (
@@ -228,34 +315,50 @@ def _paged_kernel(
 _LANES = 128
 
 
-def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale):
+def _page_walk_specs(page_size, heads, head_dim, quantized):
+    """K/V (and, for int8 pools, scale-pool) BlockSpecs: one page per grid
+    step, chosen through the prefetched block table — this is the whole
+    point of the layout: the gather happens in the index_map, not in
+    HBM-wasting XLA. Scale pages walk through the SAME index_map so a
+    token's values and its scales always arrive together."""
+    page = pl.BlockSpec(
+        (1, page_size, heads, head_dim),
+        lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
+    )
+    specs = [page, page]
+    if quantized:
+        scale_page = pl.BlockSpec(
+            (1, page_size, heads),
+            lambda b, w, bt, ln: (bt[b, w], 0, 0),
+        )
+        specs += [scale_page, scale_page]
+    return specs
+
+
+def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale,
+                  k_scales=None, v_scales=None):
     batch, heads, head_dim = q.shape
     _, page_size, _, _ = k_pages.shape
     windows = block_table.shape[1]
+    quantized = k_scales is not None
 
+    operands = [block_table, lengths, q, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel,
             scale=scale,
             page_size=page_size,
             windows=windows,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(batch, windows),
             in_specs=[
                 pl.BlockSpec((1, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0)),
-                # One K/V page per grid step, chosen through the prefetched
-                # block table — this is the whole point of the layout: the
-                # gather happens in the index_map, not in HBM-wasting XLA.
-                pl.BlockSpec(
-                    (1, page_size, heads, head_dim),
-                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, page_size, heads, head_dim),
-                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
-                ),
+                *_page_walk_specs(page_size, heads, head_dim, quantized),
             ],
             out_specs=pl.BlockSpec(
                 (1, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0)
@@ -266,9 +369,11 @@ def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale):
                 pltpu.VMEM((heads, head_dim), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, v_pages.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            q.shape, jnp.float32 if quantized else v_pages.dtype
+        ),
         interpret=_interpreting(),
-    )(block_table, lengths, q, k_pages, v_pages)
+    )(*operands)
     return out
 
 
@@ -282,13 +387,19 @@ def _paged_pallas(q, k_pages, v_pages, block_table, lengths, scale):
 # decode-step numerics (and their token-identity pins) cannot move.
 
 
-def _paged_reference_mq(q, k_pages, v_pages, block_table, lengths, scale):
+def _paged_reference_mq(q, k_pages, v_pages, block_table, lengths, scale,
+                        k_scales=None, v_scales=None):
     batch, q_len, heads, head_dim = q.shape
     _, page_size, _, _ = k_pages.shape
     windows = block_table.shape[1]
 
-    k = k_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
-    v = v_pages[block_table].reshape(batch, windows * page_size, heads, head_dim)
+    tokens = windows * page_size
+    k = _gather_dequant(
+        k_pages, k_scales, block_table, batch, tokens, heads, head_dim
+    )
+    v = _gather_dequant(
+        v_pages, v_scales, block_table, batch, tokens, heads, head_dim
+    )
 
     scores = (
         jnp.einsum("bqnd,btnd->bnqt", q, k, preferred_element_type=jnp.float32)
@@ -309,16 +420,18 @@ def _paged_kernel_mq(
     q_ref,  # [1, Q, H, D]
     k_ref,  # [1, P, H, D]
     v_ref,  # [1, P, H, D]
-    o_ref,  # [1, Q, H, D]
-    m_ref,  # VMEM [H, Q, LANES] f32
-    l_ref,  # VMEM [H, Q, LANES] f32
-    acc_ref,  # VMEM [H, Q, D] f32
-    *,
+    *refs,  # [ks_ref, vs_ref (int8 pools only)], o_ref, m/l/acc scratch
     scale: float,
     page_size: int,
     windows: int,
     q_len: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     w = pl.program_id(1)
     length = len_ref[b]
@@ -341,6 +454,9 @@ def _paged_kernel_mq(
         q = q_ref[0].astype(jnp.float32)  # [Q, H, D]
         k = k_ref[0].astype(jnp.float32)  # [P, H, D]
         v = v_ref[0].astype(jnp.float32)  # [P, H, D]
+        if quantized:
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
 
         # [H, Q, P]: batch over heads (q dim 1 / k dim 1), contract head_dim.
         s = (
@@ -381,11 +497,16 @@ def _paged_kernel_mq(
         o_ref[0] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
 
 
-def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale):
+def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale,
+                     k_scales=None, v_scales=None):
     batch, q_len, heads, head_dim = q.shape
     _, page_size, _, _ = k_pages.shape
     windows = block_table.shape[1]
+    quantized = k_scales is not None
 
+    operands = [block_table, lengths, q, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel_mq,
@@ -393,6 +514,7 @@ def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale):
             page_size=page_size,
             windows=windows,
             q_len=q_len,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -402,14 +524,7 @@ def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale):
                     (1, q_len, heads, head_dim),
                     lambda b, w, bt, ln: (b, 0, 0, 0),
                 ),
-                pl.BlockSpec(
-                    (1, page_size, heads, head_dim),
-                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, page_size, heads, head_dim),
-                    lambda b, w, bt, ln: (bt[b, w], 0, 0, 0),
-                ),
+                *_page_walk_specs(page_size, heads, head_dim, quantized),
             ],
             out_specs=pl.BlockSpec(
                 (1, q_len, heads, head_dim), lambda b, w, bt, ln: (b, 0, 0, 0)
@@ -420,7 +535,9 @@ def _paged_pallas_mq(q, k_pages, v_pages, block_table, lengths, scale):
                 pltpu.VMEM((heads, q_len, head_dim), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, v_pages.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            q.shape, jnp.float32 if quantized else v_pages.dtype
+        ),
         interpret=_interpreting(),
-    )(block_table, lengths, q, k_pages, v_pages)
+    )(*operands)
     return out

@@ -372,6 +372,108 @@ int8_dense_delayed_grads.defvjp(
 )
 
 
+# ------------------------------------------- serving (weight-only int8)
+#
+# The serve engine stores matmul weights as int8 + fp32 per-output-channel
+# scales and dequantizes INSIDE its jitted programs (a broadcast multiply
+# that XLA fuses into the matmul's operand read) — resident weight bytes
+# halve while every activation and accumulation stays in the compute
+# dtype. Unlike the train path above there is no dynamic activation
+# quantization: this is the LLM.int8/AWQ-style weight-only layout, chosen
+# because serving batches are small enough that weights dominate HBM.
+#
+# Scales keep their contracted axes as size-1 dims (keepdims) so (a) the
+# dequant is a plain broadcast multiply and (b) under tensor parallelism
+# the scale shards with the SAME partition spec as its kernel wherever the
+# kernel's sharded axis survives in the scale (parallel/sharding.py nulls
+# the size-1 axes).
+
+# serve modules whose kernels quantize -> number of leading contracted
+# kernel axes (DenseGeneral layout: [*contracted, *features]); embeddings,
+# layer norms, biases and the tied LM head stay in param dtype
+_SERVE_QUANT_MODULES = {
+    "query": 1, "key": 1, "value": 1, "out": 2,
+    "mlp_up": 1, "mlp_down": 1,
+}
+
+
+def quantize_kernel(kernel, n_contract: int):
+    """→ (int8 kernel, fp32 per-output-channel scale with the contracted
+    axes kept as size-1 dims). ``kernel ≈ q.astype(f32) * scale``."""
+    axes = tuple(range(n_contract))
+    scale = _absmax(kernel, axes=axes, keepdims=True) / _INT8_MAX
+    return _quantize(kernel, scale), scale
+
+
+def quantize_serve_params(params):
+    """Weight-only int8 variant of a serve params tree.
+
+    Every attention/MLP projection kernel (``_SERVE_QUANT_MODULES``)
+    becomes int8 with a sibling ``kernel_scale`` fp32 leaf; everything
+    else passes through untouched. Idempotent: an already-quantized tree
+    is returned as-is, so swap paths can call it unconditionally."""
+    def walk(node, name):
+        if not isinstance(node, dict):
+            return node
+        if name in _SERVE_QUANT_MODULES and "kernel" in node:
+            out = dict(node)
+            kernel = out["kernel"]
+            if kernel.dtype == jnp.int8:
+                return out
+            q, scale = quantize_kernel(kernel, _SERVE_QUANT_MODULES[name])
+            out["kernel"] = q
+            out["kernel_scale"] = scale
+            return out
+        return {k: walk(v, k) for k, v in node.items()}
+
+    return walk(dict(params), "")
+
+
+def dequantize_serve_params(params):
+    """Inverse of :func:`quantize_serve_params`: rebuild the fp32 tree by
+    broadcasting each ``kernel_scale`` back over its int8 kernel (the
+    scale leaf is dropped). A tree without scales passes through — the
+    jitted programs call this unconditionally as their first op."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if "kernel_scale" in node:
+            out = {k: walk(v) for k, v in node.items()
+                   if k != "kernel_scale"}
+            out["kernel"] = (
+                node["kernel"].astype(jnp.float32) * node["kernel_scale"]
+            )
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
+
+
+def serve_params_variant(params) -> str:
+    """``"int8"`` when the tree carries quantized serve kernels (any
+    ``kernel_scale`` leaf), else ``"fp32"`` — how swap/publish paths
+    detect which precision variant a weight tree is."""
+    found = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "kernel_scale" in node:
+                found.append(True)
+            for v in node.values():
+                walk(v)
+
+    walk(params)
+    return "int8" if found else "fp32"
+
+
+def quantize_kv(values, axis: int = -1):
+    """Symmetric int8 quantization of K/V page writes: one fp32 scale per
+    everything-but-``axis`` (the head_dim axis reduces away). → (int8
+    values, fp32 scales with ``axis`` dropped)."""
+    scale = _absmax(values, axes=axis, keepdims=True) / _INT8_MAX
+    return _quantize(values, scale), jnp.squeeze(scale, axis=axis)
+
+
 def int8_matmul(x2d, w2d, mode: str = "fwd"):
     """2-D convenience wrapper over :func:`int8_dense` ([T,K]·[K,N])."""
     return int8_dense(x2d, w2d, 1, mode)

@@ -49,11 +49,24 @@ class ShardingPolicy:
     fsdp_min_size: int = 2**16
 
 
-def _tp_spec(path: tuple[str, ...], ndim: int) -> P | None:
+def _tp_spec(path: tuple[str, ...], shape: tuple[int, ...]) -> P | None:
     """Megatron TP specs keyed on this framework's BERT parameter layout
     (models/bert.py). Returns None when TP doesn't apply to the leaf."""
     names = set(path)
     leaf = path[-1]
+    ndim = len(shape)
+    if leaf == "kernel_scale":
+        # weight-only int8 scale (ops/quant.py quantize_kernel): same rank
+        # as its kernel with the contracted axes kept as size-1 dims —
+        # shard exactly like the kernel wherever the kernel's sharded axis
+        # survives in the scale, and replicate the size-1 dims (a mesh
+        # axis cannot split a singleton).
+        spec = _tp_spec(path[:-1] + ("kernel",), shape)
+        if spec is None:
+            return None
+        return P(*(
+            axis if shape[i] != 1 else None for i, axis in enumerate(spec)
+        ))
     if "attention" in names:
         # query/key/value: kernel [hidden, heads, head_dim], bias [heads, hd]
         if any(n in names for n in ("query", "key", "value")):
@@ -125,9 +138,10 @@ def _leaf_spec(path, leaf, policy: ShardingPolicy, mesh: Mesh) -> P:
         # stacked dim (n_branches / num_layers) not divisible by the axis —
         # replicate rather than crash; the caller picked an odd mesh.
         lead = None
-    inner_ndim = leaf.ndim - (1 if lead else 0)
+    inner_shape = tuple(leaf.shape[1:] if lead else leaf.shape)
+    inner_ndim = len(inner_shape)
     if policy.tp and mesh.shape["model"] > 1 and lead != "model":
-        spec = _tp_spec(names, inner_ndim)
+        spec = _tp_spec(names, inner_shape)
     if lead:
         inner = list(spec) if spec is not None else []
         inner += [None] * (inner_ndim - len(inner))
@@ -161,20 +175,26 @@ def serve_param_shardings(params, mesh: Mesh,
     )
 
 
-def serve_pool_pspec() -> P:
+def serve_pool_pspec(ndim: int = 4) -> P:
     """PartitionSpec for one paged-KV pool leaf ``[num_pages, page_size,
     heads, head_dim]``: heads shard over ``model`` so each shard owns its
     own page pool at 1/N width — page indices, block tables and the
     allocator arithmetic are untouched (they address the page axis, which
-    stays whole)."""
+    stays whole). Rank-3 leaves are the int8 pools' fp32 scale pools
+    ``[num_pages, page_size, heads]`` (kv_cache_dtype='int8'); their heads
+    axis shards with the value pool it scales."""
+    if ndim == 3:
+        return P(None, None, "model")
     return P(None, None, "model", None)
 
 
 def serve_pool_shardings(pools, mesh: Mesh):
-    """NamedSharding pytree for the engine's paged K/V pools (every leaf
-    is a ``[num_pages, page_size, heads, head_dim]`` pool)."""
-    spec = serve_pool_pspec()
-    return jax.tree.map(lambda _: NamedSharding(mesh, spec), pools)
+    """NamedSharding pytree for the engine's paged K/V pools (rank-4 value
+    pools, plus rank-3 scale pools when the cache is int8)."""
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, serve_pool_pspec(getattr(leaf, "ndim", 4))),
+        pools,
+    )
 
 
 def state_shardings(state: TrainState, policy: ShardingPolicy, mesh: Mesh):

@@ -114,6 +114,11 @@ from pytorch_distributed_training_tpu.analysis.spmd.manifest import (
     serve_tp_manifest,
 )
 from pytorch_distributed_training_tpu.faults.watchdog import watchdog_guard
+from pytorch_distributed_training_tpu.ops.quant import (
+    dequantize_serve_params,
+    quantize_serve_params,
+    serve_params_variant,
+)
 from pytorch_distributed_training_tpu.serve.paged_cache import (
     PageAllocator,
     strip_tables,
@@ -189,6 +194,17 @@ class EngineConfig:
     # on the head dim. 1 = today's single-device engine, bit-identical
     # streams either way. Requires kv_layout="paged" + sampling="device".
     tp: int = 1
+    # Serving precision variants. weights_dtype="int8" quantizes every
+    # attention/MLP matmul weight ONCE at engine build (per-output-channel
+    # scales, ops/quant.quantize_serve_params); the jitted programs
+    # dequantize in-trace, so activations/logits/sampling stay fp32 while
+    # resident weight bytes roughly halve. kv_dtype="int8" stores the
+    # paged K/V pools as int8 with fp32 per-page-per-head scale pools
+    # riding beside the block tables (allocator arithmetic and admission
+    # are dtype-invariant). Both compose with tp and speculation;
+    # "float32" keeps today's exact baseline.
+    weights_dtype: str = "float32"
+    kv_dtype: str = "float32"
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -250,6 +266,20 @@ class EngineConfig:
                 raise ValueError("tp > 1 requires kv_layout='paged'")
             if self.sampling != "device":
                 raise ValueError("tp > 1 requires sampling='device'")
+        if self.weights_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"weights_dtype must be float32/int8, got "
+                f"{self.weights_dtype!r}"
+            )
+        if self.kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be float32/int8, got {self.kv_dtype!r}"
+            )
+        if self.kv_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype='int8' requires kv_layout='paged' (the dense "
+                "cache has no scale-pool layout)"
+            )
         if self.kv_layout == "paged" and self.num_pages > 0:
             if self.num_pages < self.pages_per_slot + 1:
                 raise ValueError(
@@ -391,6 +421,15 @@ class DecodeEngine:
                 f"(speculative drafts occupy positions past the committed "
                 f"context before acceptance is known)"
             )
+        # Resident precision variant: fixed for the engine's lifetime by
+        # weights_dtype (the compiled programs' input dtypes never change,
+        # which is what keeps variant hot-swaps retrace-free). Weight-only
+        # int8 quantizes the matmul kernels ONCE here — per-output-channel
+        # fp32 scales ride the tree as kernel_scale leaves — and every
+        # jitted program below dequantizes in-trace.
+        self.variant = "int8" if config.weights_dtype == "int8" else "fp32"
+        if config.weights_dtype == "int8":
+            params = quantize_serve_params(params)
         # Tensor-parallel mesh (tp > 1): every jitted program below runs
         # under pjit over a `model`-axis mesh — params shard by the serve
         # rules (heads / MLP hidden), pools shard on the head dim, and all
@@ -399,7 +438,6 @@ class DecodeEngine:
         # placement error, not a resharding).
         self._mesh = None
         self._param_shardings = None
-        self._pool_sharding = None
         self._repl = None
         if config.tp > 1:
             from pytorch_distributed_training_tpu.comms.mesh import (
@@ -421,13 +459,6 @@ class DecodeEngine:
             self._repl = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec()
             )
-            from pytorch_distributed_training_tpu.parallel.sharding import (
-                serve_pool_pspec,
-            )
-
-            self._pool_sharding = jax.sharding.NamedSharding(
-                self._mesh, serve_pool_pspec()
-            )
         paged = config.kv_layout == "paged"
         dcfg = dataclasses.replace(cfg, decode=True, kv_layout=config.kv_layout)
         if paged:
@@ -436,6 +467,9 @@ class DecodeEngine:
                 kv_page_size=config.page_size,
                 kv_num_pages=config.total_pages,
                 paged_attention_impl=config.paged_attention_impl,
+                kv_cache_dtype=(
+                    "int8" if config.kv_dtype == "int8" else "auto"
+                ),
             )
         self._decode_model = type(model)(dcfg)
         # Multi-token-query view of the SAME decode model (shared params,
@@ -488,12 +522,19 @@ class DecodeEngine:
                 kv_num_pages=config.total_pages,
                 paged_attention_impl=config.paged_attention_impl,
                 scan_layers=False,
+                kv_cache_dtype=(
+                    "int8" if config.kv_dtype == "int8" else "auto"
+                ),
             )
             self._draft_model = type(draft_model)(ddcfg)
             if config.prefill_chunk > 0:
                 self._draft_mq_model = type(draft_model)(
                     dataclasses.replace(ddcfg, paged_multiquery=True)
                 )
+            if config.weights_dtype == "int8":
+                # the draft lane serves at the same precision variant as
+                # the base model (same dequant-in-trace scheme)
+                draft_params = quantize_serve_params(draft_params)
             if self._mesh is not None:
                 _check_tp_divisible(dmc, config.tp, "draft")
                 from pytorch_distributed_training_tpu.parallel.sharding import (  # noqa: E501
@@ -529,8 +570,9 @@ class DecodeEngine:
         self.swaps = 0              # committed swaps
         self.swap_rollbacks = 0     # trial-tick failures rolled back
         self._swap_lock = concurrency.lock("serve.engine.swap")
-        self._pending_swap = None   # (params, version, SwapTicket)
+        self._pending_swap = None   # (params, version, ticket, variant)
         self._trial = None          # (prev_params, prev_version, ticket)
+        self._last_swap_variant = None  # incoming variant of newest swap
         if registry is None:
             from pytorch_distributed_training_tpu.telemetry.registry import (
                 get_registry,
@@ -564,13 +606,13 @@ class DecodeEngine:
             self._cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), strip_tables(shapes)
             )
-            if self._pool_sharding is not None:
+            if self._mesh is not None:
                 # pools split on the head dim (each shard owns its own
                 # 1/N-width page pool); the page axis stays whole so the
-                # allocator's block-table arithmetic is untouched
-                self._cache = jax.device_put(
-                    self._cache, self._pool_sharding
-                )
+                # allocator's block-table arithmetic is untouched. Per-leaf
+                # shardings: int8 pools carry rank-3 fp32 scale pools whose
+                # heads axis shards with the values they scale.
+                self._cache = self._place_pools(self._cache)
             self._pages = PageAllocator(
                 config.total_pages, config.page_size,
                 config.pages_per_slot, config.num_slots,
@@ -587,10 +629,8 @@ class DecodeEngine:
                     lambda s: jnp.zeros(s.shape, s.dtype),
                     strip_tables(dshapes),
                 )
-                if self._pool_sharding is not None:
-                    self._draft_cache = jax.device_put(
-                        self._draft_cache, self._pool_sharding
-                    )
+                if self._mesh is not None:
+                    self._draft_cache = self._place_pools(self._draft_cache)
         else:
             # Per-slot cache template comes from a batch-1 abstract init at
             # the full cache length (no params materialized); the resident
@@ -660,6 +700,18 @@ class DecodeEngine:
             return jax.device_put(tree)
         return jax.device_put(tree, self._repl)
 
+    def _place_pools(self, pools):
+        """Shard a K/V pool tree over the tp mesh: rank-4 value pools and
+        (int8 cache) rank-3 scale pools both split on their heads axis —
+        shape-aware per leaf, one placement."""
+        from pytorch_distributed_training_tpu.parallel.sharding import (
+            serve_pool_shardings,
+        )
+
+        return jax.device_put(
+            pools, serve_pool_shardings(pools, self._mesh)
+        )
+
     @property
     def param_shardings(self):
         """Per-leaf NamedShardings of the serving params (None when
@@ -688,6 +740,16 @@ class DecodeEngine:
         if self.config.tp > 1:
             mcfg = self._decode_model.config
             q = 1 + (self.config.spec_k if name == "serve_verify" else 0)
+            # dtype-aware ceiling: the smallest sharded projection (the
+            # hidden x hidden attention-out kernel) at the RESIDENT weight
+            # byte width — 1 byte/element for weight-only int8 — so an
+            # int8 replica's contract is pinned at the smaller count and a
+            # program that moved even one weight matrix on top of its
+            # activations fails the audit at compile time.
+            wbytes = (
+                1 if self.config.weights_dtype == "int8"
+                else jnp.dtype(mcfg.param_dtype).itemsize
+            )
             return serve_tp_manifest(
                 self.config.tp,
                 layers=mcfg.num_layers,
@@ -695,6 +757,8 @@ class DecodeEngine:
                 max_q_tokens=self.config.num_slots * q,
                 dtype_bytes=jnp.dtype(mcfg.compute_dtype).itemsize,
                 name=name,
+                weight_bytes_floor=mcfg.hidden_size * mcfg.hidden_size
+                * wbytes,
             )
         return serve_manifest(1, name=name)
 
@@ -731,6 +795,10 @@ class DecodeEngine:
 
             def prefill(params, pools, ids, real_len, bt_row, seed, temp,
                         top_k):
+                # weight-only int8: dequantize in-trace (identity on fp32
+                # trees) — XLA folds the broadcast multiply into the
+                # matmuls, so only int8 kernels + scales stay resident
+                params = dequantize_serve_params(params)
                 # fresh sequence: context_len 0, K/V scattered straight
                 # into the slot's pages through its block-table row
                 cache = with_tables(
@@ -752,6 +820,7 @@ class DecodeEngine:
 
             def prefill(params, cache, slot, ids, real_len, seed, temp,
                         top_k):
+                params = dequantize_serve_params(params)
                 # slot's private cache, position state reset for the new
                 # request
                 slot_cache = jax.tree.map(
@@ -823,6 +892,7 @@ class DecodeEngine:
 
             def decode(params, pools, tokens, bt, ctx, seeds, steps, temps,
                        top_ks):
+                params = dequantize_serve_params(params)
                 cache = with_tables(pools, bt, ctx)
                 logits, vars_ = self._decode_model.apply(
                     {"params": params, "cache": cache},
@@ -855,6 +925,7 @@ class DecodeEngine:
 
             def decode(params, cache, tokens, active, seeds, steps, temps,
                        top_ks):
+                params = dequantize_serve_params(params)
                 logits, new_cache = jax.vmap(
                     one, in_axes=(None, 0, 0, 0)
                 )(params, cache, tokens, active)
@@ -898,6 +969,7 @@ class DecodeEngine:
 
         def verify(params, pools, tokens, bt, ctx, seeds, steps0, temps,
                    top_ks):
+            params = dequantize_serve_params(params)
             cache = with_tables(pools, bt, ctx)
             logits, vars_ = self._mq_model.apply(
                 {"params": params, "cache": cache},
@@ -940,6 +1012,7 @@ class DecodeEngine:
 
         def chunk(params, pools, ids, ctx0, sample_idx, bt_row, seed, temp,
                   top_k):
+            params = dequantize_serve_params(params)
             cache = with_tables(pools, bt_row, ctx0)
             logits, vars_ = self._mq_model.apply(
                 {"params": params, "cache": cache},
@@ -977,6 +1050,7 @@ class DecodeEngine:
             return self._draft_decode_fn_
 
         def draft_decode(params, pools, tokens, bt, ctx):
+            params = dequantize_serve_params(params)
             cache = with_tables(pools, bt, ctx)
             logits, vars_ = self._draft_model.apply(
                 {"params": params, "cache": cache},
@@ -1007,6 +1081,7 @@ class DecodeEngine:
             return fn
 
         def draft_prefill(params, pools, ids, bt_row):
+            params = dequantize_serve_params(params)
             cache = with_tables(pools, bt_row, jnp.zeros((1,), jnp.int32))
             _, vars_ = self._draft_model.apply(
                 {"params": params, "cache": cache},
@@ -1031,6 +1106,7 @@ class DecodeEngine:
         C = self.config.prefill_chunk
 
         def draft_chunk(params, pools, ids, ctx0, bt_row):
+            params = dequantize_serve_params(params)
             cache = with_tables(pools, bt_row, ctx0)
             _, vars_ = self._draft_mq_model.apply(
                 {"params": params, "cache": cache},
@@ -1219,12 +1295,33 @@ class DecodeEngine:
                     f"checkpoint from an incompatible model config)"
                 )
 
+    def _coerce_variant(self, params):
+        """Convert an incoming swap tree to the engine's RESIDENT
+        precision variant; returns ``(converted tree, incoming variant
+        name)``. An fp32 publish swapping into an int8 engine is
+        re-quantized (per-channel scales recomputed); an int8 publish
+        swapping into an fp32 engine is dequantized. Matching variants
+        pass through untouched. Because the resident representation never
+        changes, a variant transition is an ordinary zero-retrace swap —
+        the warm programs' input shapes/dtypes are invariant."""
+        incoming = serve_params_variant(params)
+        if incoming == self.variant:
+            return params, incoming
+        if self.variant == "int8":
+            return quantize_serve_params(params), incoming
+        return dequantize_serve_params(params), incoming
+
     def request_swap(self, params, version: Optional[int]) -> SwapTicket:
         """Queue a validated weight swap from ANY thread; the serve loop
         applies it between ticks. Returns a ticket whose ``done`` event
         fires at commit or rollback. Raises ``ValueError`` on a tree that
         can't serve under the running model (nothing is queued) and
-        ``RuntimeError`` while another swap is still in flight."""
+        ``RuntimeError`` while another swap is still in flight.
+        Precision-variant aware: the incoming tree's variant (fp32 vs
+        weight-only int8) is detected and coerced to the resident variant
+        BEFORE validation, so a variant swap is an explicit admitted
+        transition, recorded by name — not a shape/dtype rejection."""
+        params, variant = self._coerce_variant(params)
         self._validate_swap(params)
         # tp: re-place onto the SAME per-leaf shardings the warm programs
         # were compiled against — a replicated (or device-0) replacement
@@ -1240,17 +1337,22 @@ class DecodeEngine:
                     "a weight swap is already pending; one at a time"
                 )
             ticket = SwapTicket(version)
-            self._pending_swap = (placed, version, ticket)
+            self._pending_swap = (placed, version, ticket, variant)
         return ticket
 
     def swap_params(self, params, version: Optional[int],
-                    ticket: Optional[SwapTicket] = None) -> None:
+                    ticket: Optional[SwapTicket] = None, *,
+                    variant: Optional[str] = None) -> None:
         """Atomically install ``params`` as the serving weights. MUST run
         between ticks (the serve loop calls it at tick start via
         ``request_swap``; direct calls are for single-threaded use). The
         resident KV state and the compiled programs are untouched — slots
         in flight continue on the new weights — and the previous params are
         kept alive until ``_commit_swap`` (first clean post-swap tick)."""
+        if variant is None:
+            # direct (single-threaded) callers get the same variant
+            # coercion request_swap applies before queueing
+            params, variant = self._coerce_variant(params)
         self._validate_swap(params)
         prev_params, prev_version = self._params, self.weights_step
         self._params = (
@@ -1260,11 +1362,15 @@ class DecodeEngine:
         )
         self.weights_step = version
         self._trial = (prev_params, prev_version, ticket)
+        self._last_swap_variant = variant
         self._registry.inc("serve/swaps_applied")
         self._registry.emit({
             "record": "swap_applied",
             "version": version,
             "from_version": prev_version,
+            # which precision variant was PUBLISHED (the resident variant
+            # it was coerced to is fixed per engine: stats()["variant"])
+            "variant": variant,
         })
 
     def _commit_swap(self) -> None:
@@ -1276,6 +1382,7 @@ class DecodeEngine:
         self._registry.emit({
             "record": "swap_committed",
             "version": self.weights_step,
+            "variant": self._last_swap_variant,
         })
         if ticket is not None:
             ticket.resolve(True)
@@ -1828,9 +1935,9 @@ class DecodeEngine:
         with self._swap_lock:
             pending, self._pending_swap = self._pending_swap, None
         if pending is not None:
-            params, version, ticket = pending
+            params, version, ticket, variant = pending
             try:
-                self.swap_params(params, version, ticket)
+                self.swap_params(params, version, ticket, variant=variant)
             except Exception as e:  # pragma: no cover - validated at request
                 if ticket is not None:
                     ticket.resolve(
@@ -2039,6 +2146,22 @@ class DecodeEngine:
             self._registry.inc("serve/cancelled")
             self._finish(req, "cancelled", "cancelled")
 
+    def _kv_bytes_per_token(self) -> int:
+        """Resident pool bytes one committed token occupies across every
+        layer (K and V): ``head_dim`` values per head at the pool dtype,
+        plus one fp32 scale per entry per head when the pools are int8 —
+        the capacity arithmetic behind the int8 cache's concurrency win
+        (at head_dim 64 and fp32 compute, int8 pools cost (64+4)/256 of
+        the fp32 bytes per token)."""
+        mcfg = self._decode_model.config
+        if self.config.kv_dtype == "int8":
+            per_head = mcfg.head_dim + 4
+        else:
+            per_head = (
+                mcfg.head_dim * jnp.dtype(mcfg.compute_dtype).itemsize
+            )
+        return 2 * mcfg.num_layers * mcfg.num_heads * per_head
+
     def stats(self) -> dict:
         paged = self._pages is not None
         return {
@@ -2060,6 +2183,12 @@ class DecodeEngine:
             "kv_layout": self.config.kv_layout,
             "sampling": self.config.sampling,
             "tp": self.config.tp,
+            "weights_dtype": self.config.weights_dtype,
+            "kv_dtype": self.config.kv_dtype,
+            "variant": self.variant,
+            "kv_bytes_per_token": (
+                self._kv_bytes_per_token() if paged else None
+            ),
             "kv_page_size": self.config.page_size if paged else None,
             "kv_pages_total": self._pages.num_pages - 1 if paged else None,
             "kv_pages_used": self._pages.pages_used if paged else None,

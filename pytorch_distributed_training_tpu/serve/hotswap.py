@@ -361,6 +361,10 @@ def load_swap_params(directory: str, step: int, *, current_params,
         saved_params_scanned,
     )
 
+    from pytorch_distributed_training_tpu.ops.quant import (
+        serve_params_variant,
+    )
+
     if saved_params_scanned(directory, step=step) and not has_scanned_trunk(
         current_params
     ):
@@ -368,9 +372,30 @@ def load_swap_params(directory: str, step: int, *, current_params,
             restore_params(directory, step=step)
         )
     else:
-        params = restore_params(
-            directory, params_like=current_params, step=step
+        # Precision-variant-aware restore: a step published as the OTHER
+        # variant (fp32 vs weight-only int8) has a different tree
+        # structure — kernel_scale leaves — so the params_like partial
+        # restore would reject it. The sealed manifest records the
+        # published variant; on mismatch restore the tree whole.
+        manifest = read_manifest(
+            os.path.join(os.path.abspath(directory), str(step))
         )
+        published = (manifest or {}).get("variant")
+        if published is not None and published != serve_params_variant(
+            current_params
+        ):
+            params = restore_params(directory, step=step)
+        else:
+            params = restore_params(
+                directory, params_like=current_params, step=step
+            )
+    if serve_params_variant(params) != serve_params_variant(current_params):
+        # cross-variant swap: the engine's request_swap coerces the tree
+        # to its resident variant and re-places it onto the programs'
+        # shardings — placing HERE onto the mismatched sharding tree
+        # would fail, and a replicated placement would just transfer the
+        # bytes twice. Hand back the host tree as-is.
+        return params
     if shardings is not None:
         return jax.device_put(params, shardings)
     return jax.device_put(params)
@@ -558,16 +583,39 @@ class HotSwapManager:
 # --------------------------------------------------------------- publishing
 
 
-def publish_params_checkpoint(directory: str, step: int, params) -> str:
+def publish_params_checkpoint(directory: str, step: int, params, *,
+                              variant: Optional[str] = None) -> str:
     """Publish a params-only checkpoint step the hot-swap pipeline can
     admit: orbax ``{"params": ...}`` step + the sealed integrity manifest
     (written AFTER commit, fsynced — train/manifest.py's torn-publish
     guarantee). This is the full publish contract in one call: what a
-    fine-tuning job's export hook (and the swap tests/bench) use."""
+    fine-tuning job's export hook (and the swap tests/bench) use.
+
+    ``variant`` selects the published precision variant: ``"int8"``
+    quantizes the matmul weights (ops/quant.quantize_serve_params — the
+    checkpoint ships int8 kernels + fp32 per-channel scales at roughly
+    half the weight bytes), ``"fp32"`` dequantizes an already-quantized
+    tree, ``None`` publishes the tree as-is. The manifest records the
+    variant so ``load_swap_params`` knows whether a cross-variant restore
+    (different tree structure) is needed."""
     import orbax.checkpoint as ocp
 
+    from pytorch_distributed_training_tpu.ops.quant import (
+        dequantize_serve_params,
+        quantize_serve_params,
+        serve_params_variant,
+    )
     from pytorch_distributed_training_tpu.train import manifest as m
 
+    if variant is not None:
+        if variant not in ("fp32", "int8"):
+            raise ValueError(
+                f"variant must be fp32/int8/None, got {variant!r}"
+            )
+        params = (
+            quantize_serve_params(params) if variant == "int8"
+            else dequantize_serve_params(params)
+        )
     directory = os.path.abspath(directory)
     with ocp.CheckpointManager(
         directory,
@@ -580,10 +628,9 @@ def publish_params_checkpoint(directory: str, step: int, params) -> str:
             directory, ocp.step.standard_name_format(), step=step
         )
     )
-    m.write_manifest(
-        step_path,
-        m.build_manifest(
-            step_path, step, tree=m.tree_summary({"params": params})
-        ),
+    man = m.build_manifest(
+        step_path, step, tree=m.tree_summary({"params": params})
     )
+    man["variant"] = serve_params_variant(params)
+    m.write_manifest(step_path, man)
     return step_path

@@ -156,6 +156,14 @@ class ModelConfig:
     # "reference" = XLA gather+einsum (bitwise-pinned against the dense
     # cache path); "pallas" = the online-softmax page-walk kernel.
     paged_attention_impl: str = "reference"
+    # Storage dtype of the paged K/V pools (paged layout only): "auto"
+    # stores pages in the compute dtype (the classic layout); "int8" stores
+    # symmetric per-entry-per-head quantized pages plus fp32 ``k_scales``/
+    # ``v_scales`` pools of shape [num_pages, page_size, heads] beside the
+    # block tables — quantize-on-write at the scatter site, dequantize
+    # in-kernel on read (ops/paged_attention.py). Allocator arithmetic and
+    # block tables are dtype-invariant; only the pool bytes change.
+    kv_cache_dtype: str = "auto"
     # Multi-token-query paged decode (speculative verify / chunked prefill):
     # a chunk of new tokens is scattered into the pages and then attends
     # causally over the WHOLE context (prior pages + itself) through the
@@ -239,6 +247,17 @@ class ModelConfig:
             raise ValueError(
                 f"paged_attention_impl must be reference/pallas, got "
                 f"{self.paged_attention_impl!r}"
+            )
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be auto/int8, got "
+                f"{self.kv_cache_dtype!r}"
+            )
+        if self.kv_cache_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_cache_dtype='int8' requires kv_layout='paged' (the "
+                "dense cache has no scale-pool layout); got "
+                f"kv_layout={self.kv_layout!r}"
             )
         if self.kv_layout == "paged" and self.kv_page_size < 1:
             raise ValueError(

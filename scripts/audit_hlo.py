@@ -25,6 +25,11 @@ Usage:
                               # an N-way model-axis mesh and audit each
                               # against serve_tp_manifest; same --json /
                               # --check contract as the train-step audit
+  --int8                      # with --serve-tp: build the int8 variant
+                              # (weight-only int8 matmuls + int8 KV pages)
+                              # so the audit checks the sharded quantized
+                              # programs against the dtype-aware manifest
+                              # (weight-bytes floor priced at 1 B/elem)
 """
 
 import argparse
@@ -68,6 +73,9 @@ def _parse_args(argv):
     p.add_argument("--serve-tp", type=int, default=None,
                    help="audit the tensor-parallel serve programs over an "
                         "N-way model-axis mesh instead of the train step")
+    p.add_argument("--int8", action="store_true",
+                   help="with --serve-tp: audit the int8 serve variant "
+                        "(weight-only int8 + int8 KV pages)")
     return p.parse_args(argv)
 
 
@@ -139,6 +147,9 @@ def _serve_tp_audit(args):
         jax.random.key(0), jnp.ones((1, 8), jnp.int32)
     )["params"]
 
+    dtype_kw = (
+        {"weights_dtype": "int8", "kv_dtype": "int8"} if args.int8 else {}
+    )
     audits = []
     for spec_k in (0, 3):
         registry = MetricsRegistry()
@@ -151,7 +162,7 @@ def _serve_tp_audit(args):
             EngineConfig(
                 num_slots=2, prompt_buckets=(8,), max_new_tokens=8,
                 kv_layout="paged", sampling="device", page_size=4,
-                spec_k=spec_k, warmup=True, tp=tp,
+                spec_k=spec_k, warmup=True, tp=tp, **dtype_kw,
             ),
             queue_depth=2, registry=registry,
         )
@@ -161,7 +172,8 @@ def _serve_tp_audit(args):
 
     ok = bool(audits) and all(a["ok"] for a in audits)
     if args.json:
-        print(json.dumps({"serve_tp": tp, "ok": ok, "audits": audits},
+        print(json.dumps({"serve_tp": tp, "int8": bool(args.int8),
+                          "ok": ok, "audits": audits},
                          indent=2, default=str))
     else:
         for a in audits:

@@ -324,6 +324,27 @@ def summarize_spec(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_precision(records: list[dict]) -> dict | None:
+    """Fold the engine's precision stamp (final ``serve_summary``) into
+    the quantization view: which variant the replica served (fp32 or
+    int8), the weight/KV dtypes behind it, and the paged pool's KV bytes
+    per token (int8 pools carry a fp32 scale per head, so the figure is
+    head_dim+4 per head, not head_dim). None when the stream predates
+    quantized serving."""
+    summaries = [r for r in records if r.get("record") == "serve_summary"]
+    if not summaries:
+        return None
+    last = summaries[-1]
+    if "weights_dtype" not in last:
+        return None     # pre-quantization stream
+    return {
+        "variant": last.get("variant"),
+        "weights_dtype": last.get("weights_dtype"),
+        "kv_dtype": last.get("kv_dtype"),
+        "kv_bytes_per_token": last.get("kv_bytes_per_token"),
+    }
+
+
 def summarize_serve(records: list[dict]) -> dict | None:
     """Fold ``serve_request`` records into per-bucket latency percentiles
     plus aggregate serving stats; None when the stream holds none."""
@@ -367,6 +388,7 @@ def summarize_serve(records: list[dict]) -> dict | None:
         "buckets": buckets,
         "paged": summarize_paged(records),
         "spec": summarize_spec(records),
+        "precision": summarize_precision(records),
     }
 
 
@@ -622,6 +644,18 @@ def render_serve_table(serve: dict) -> str:
         f"tokens/s={_fmt(serve.get('tokens_per_s'))} "
         f"queue-wait p95={_fmt(ms(qw, 'p95') if qw else None)}ms"
     )
+    precision = serve.get("precision")
+    if precision:
+        line = (
+            f"precision: variant={precision.get('variant')} "
+            f"weights={precision.get('weights_dtype')} "
+            f"kv={precision.get('kv_dtype')}"
+        )
+        if precision.get("kv_bytes_per_token") is not None:
+            line += (
+                f" kv-bytes/token={_fmt(precision['kv_bytes_per_token'])}"
+            )
+        lines.append(line)
     paged = serve.get("paged")
     if paged:
         if paged.get("kv_layout") == "paged":
