@@ -564,6 +564,7 @@ def _serve_child(cfg_json: str) -> None:
     work = list(prompts)
     lock = threading.Lock()
     rejected = [0]
+    accepted_ids = []
 
     def client():
         while True:
@@ -579,6 +580,8 @@ def _serve_child(cfg_json: str) -> None:
                     with lock:
                         rejected[0] += 1
                     time.sleep(0.002)
+            with lock:
+                accepted_ids.append(req.id)
             _await_done(req.done, "request completion")
 
     threads = [
@@ -594,6 +597,15 @@ def _serve_child(cfg_json: str) -> None:
 
     serve_summary = _serve_stats_mod().summarize_serve(sink.records)
     eng_tokens = serve_summary["tokens"]
+    # span-coverage gate: every accepted request must yield a complete,
+    # root-closed span tree with zero orphans and phase sums reconciling
+    # against the serve span (telemetry/spans.py tiles the phases, so
+    # anything else is an instrumentation regression)
+    from pytorch_distributed_training_tpu.telemetry.spans import (
+        trace_coverage,
+    )
+
+    coverage = trace_coverage(sink.records, accepted_ids=accepted_ids)
     result = {
         "metric": (
             f"serving quick bench (tiny LM, CPU, {n_requests} requests x "
@@ -612,6 +624,18 @@ def _serve_child(cfg_json: str) -> None:
             "tpot_s": serve_summary["tpot_s"],
             "queue_wait_s": serve_summary["queue_wait_s"],
             "stats": server.stats(),
+        },
+        "spans": {
+            "traces": coverage["traces"],
+            "coverage": coverage["coverage"],
+            "orphan_spans": coverage["orphan_spans"],
+            "incomplete": coverage["incomplete"],
+            "phase_sum_bad": coverage["phase_sum_bad"],
+            "span_coverage_ok": (
+                coverage["coverage"] == 1.0
+                and coverage["orphan_spans"] == 0
+                and not coverage["phase_sum_bad"]
+            ),
         },
         "sequential": {
             "tokens_per_s": round(seq_tokens / seq_wall, 2),
@@ -1501,8 +1525,11 @@ def run_fleet(
 # >= 0.99 (honest retries allowed — clients honor the Retry-After the
 # server computes), zero hung waiters, >= 1 scale-up AND >= 1 drain-based
 # scale-down with measured latencies, every shed explicit (429/503 +
-# Retry-After), and every accepted stream token-identical to an unloaded
-# greedy reference pass. Runs in a JAX_PLATFORMS=cpu subprocess.
+# Retry-After), every accepted stream token-identical to an unloaded
+# greedy reference pass, and every accepted request's spans merging into
+# a complete trace tree across the coordinator + replica streams (zero
+# orphans, phase sums reconciling). Runs in a JAX_PLATFORMS=cpu
+# subprocess.
 
 
 def _storm_prompt(prompt_len: int) -> str:
@@ -1564,6 +1591,13 @@ def _storm_child(cfg_json: str) -> None:
     sink = _ListSink()
     registry.attach_sink(sink)
 
+    # per-replica JSONL streams: the span-coverage gate merges these with
+    # the coordinator's records fleet-side (the sink flushes per emit, so
+    # even the SIGKILLed replica's completed spans survive on disk)
+    import tempfile
+
+    metrics_dir = tempfile.mkdtemp(prefix="storm-metrics-")
+
     fleet = ServeFleet(
         FleetConfig(
             num_replicas=2,
@@ -1576,6 +1610,11 @@ def _storm_child(cfg_json: str) -> None:
                 "--brownout-high", "0.75", "--brownout-low", "0.25",
                 "--brownout-clamp", "8",
             ),
+            replica_extra_args={
+                i: ("--metrics-dir", f"{metrics_dir}/replica-{i}",
+                    "--replica-name", f"replica-{i}")
+                for i in range(3)       # up to the autoscaler's ceiling
+            },
             max_restarts=2,
             backoff_s=0.2,
             drain_timeout_s=20.0,
@@ -1771,6 +1810,37 @@ def _storm_child(cfg_json: str) -> None:
     httpd.shutdown()
     fleet.stop(drain=False)
 
+    # ---- fleet-side span merge: coordinator records (router spans) +
+    # every replica's on-disk stream; every ACCEPTED request (final
+    # attempt ended "done") must merge into a complete trace tree
+    import glob as _glob
+
+    merged_records = list(sink.records)
+    for path in sorted(_glob.glob(
+        os.path.join(metrics_dir, "replica-*", "metrics.jsonl")
+    )):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    merged_records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass        # torn final line from the SIGKILL
+    accepted_rids = [
+        f"storm-{i}-{len(r['attempts']) - 1}"
+        for i, r in enumerate(results)
+        if r is not None and r["final"] == "done"
+    ]
+    from pytorch_distributed_training_tpu.telemetry.spans import (
+        trace_coverage,
+    )
+
+    span_coverage = trace_coverage(
+        merged_records, accepted_ids=accepted_rids
+    )
+
     # ---- gates
     def pct(lat: list, p: float):
         lat = sorted(lat)
@@ -1843,6 +1913,11 @@ def _storm_child(cfg_json: str) -> None:
         "sheds_all_explicit": dishonest_sheds == 0,
         "token_identity_ok": not mismatches,
         "recovered": brownout_zero and post["outcome"] == "done",
+        "span_coverage_ok": (
+            span_coverage["coverage"] == 1.0
+            and span_coverage["orphan_spans"] == 0
+            and not span_coverage["phase_sum_bad"]
+        ),
     }
     result = {
         "metric": (
@@ -1885,6 +1960,14 @@ def _storm_child(cfg_json: str) -> None:
         "recovery": {
             "brownout_returned_to_zero": brownout_zero,
             "post_storm_request": post["outcome"],
+        },
+        "spans": {
+            "accepted": len(accepted_rids),
+            "traces": span_coverage["traces"],
+            "coverage": span_coverage["coverage"],
+            "orphan_spans": span_coverage["orphan_spans"],
+            "incomplete": span_coverage["incomplete"][:5],
+            "phase_sum_bad": span_coverage["phase_sum_bad"][:5],
         },
         "pool": fleet_stats["pool"],
         "gates": gates,

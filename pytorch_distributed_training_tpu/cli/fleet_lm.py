@@ -142,6 +142,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="brownout de-escalation watermark (see serve_lm)")
     p.add_argument("--brownout-clamp", type=int, default=16,
                    help="brownout level-2 max_new_tokens cap (see serve_lm)")
+    p.add_argument("--slo-burn-high", type=float, default=0.0,
+                   help="couple the autoscaler to the router-side SLO "
+                        "burn-rate monitor: burn at/above this holds the "
+                        "pool overloaded (0 = off, the default — queue/"
+                        "page signals stay the sole policy)")
     return p
 
 
@@ -240,15 +245,33 @@ def main(argv=None) -> dict:
         value = getattr(args, flag)
         if value:
             replica_args += ["--" + flag.replace("_", "-"), value]
-    extra_args = {}
+    # per-replica identity rides every span the replica emits; pre-assign
+    # up to the autoscaler's ceiling so scaled-up replicas are named too
+    pool_ceiling = max(args.replicas, args.max_replicas)
+    extra_args = {
+        i: ("--replica-name", f"replica-{i}")
+        for i in range(pool_ceiling)
+    }
     if args.metrics_dir:
         # per-replica streams: a restarted replica appends to its own
         # file; pre-assign dirs up to the autoscaler's ceiling so scaled-
         # up replicas stream too
         extra_args = {
-            i: ("--metrics-dir", f"{args.metrics_dir}/replica-{i}")
-            for i in range(max(args.replicas, args.max_replicas))
+            i: extra_args[i] + (
+                "--metrics-dir", f"{args.metrics_dir}/replica-{i}",
+            )
+            for i in range(pool_ceiling)
         }
+
+    # coordinator-side SLO plane: the router feeds request outcomes into
+    # the burn-rate monitor; the autoscaler only *acts* on it when
+    # --slo-burn-high is set (default-off, like the brownout coupling)
+    from pytorch_distributed_training_tpu.telemetry.slo import (
+        BurnRateMonitor,
+        SloConfig,
+    )
+
+    slo_monitor = BurnRateMonitor(SloConfig(), registry=registry)
 
     fleet = ServeFleet(
         FleetConfig(
@@ -265,6 +288,7 @@ def main(argv=None) -> dict:
             max_retries=args.request_retries,
         ),
         registry=registry,
+        slo_monitor=slo_monitor,
     )
     fleet.start()
     if args.hotswap_poll_s > 0 and args.checkpoint_dir:
@@ -295,8 +319,10 @@ def main(argv=None) -> dict:
                 up_cooldown_s=args.autoscale_up_cooldown_s,
                 down_cooldown_s=args.autoscale_down_cooldown_s,
                 poll_interval_s=args.autoscale_poll_s,
+                slo_burn_high=args.slo_burn_high,
             ),
             registry=registry,
+            slo_monitor=slo_monitor,
         ).start()
     httpd = make_router_http_server(fleet.router, port=args.router_port)
     log0(

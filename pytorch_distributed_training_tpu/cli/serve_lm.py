@@ -177,6 +177,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "safe default for weights about to serve traffic)")
     p.add_argument("--metrics-dir", default=None,
                    help="stream serve telemetry (JSONL) under this directory")
+    p.add_argument("--flight-capacity", type=int, default=256,
+                   help="engine flight-recorder ring size: last N tick "
+                        "summaries dumped as a flight_dump record on "
+                        "watchdog stall, fatal tick, SIGTERM drain and "
+                        "GET /debug/flight")
+    p.add_argument("--slo-windows", default="300,3600",
+                   help="comma-separated burn-rate window lengths in "
+                        "seconds (telemetry/slo.py slo_burn records)")
+    p.add_argument("--slo-emit-s", type=float, default=5.0,
+                   help="min seconds between slo_burn records")
+    p.add_argument("--slo-burn-high", type=float, default=0.0,
+                   help="brownout coupling: burn rate at/above this reads "
+                        "as high-watermark pressure on the overload ladder "
+                        "(0 = off, the default — queue pressure stays the "
+                        "sole brownout signal)")
+    p.add_argument("--replica-name", default=None,
+                   help="replica identity stamped on spans/flight records "
+                        "(fleet mode passes replica-<i>)")
     p.add_argument("--guards", default=None,
                    choices=("off", "record", "strict"),
                    help="runtime correctness guards (analysis/guards.py) "
@@ -270,6 +288,7 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         tp=args.tp,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
+        flight_capacity=args.flight_capacity,
     )
     from pytorch_distributed_training_tpu.analysis.concurrency import (
         get_lock_registry,
@@ -286,6 +305,23 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
     guard_mode = args.guards or guard_mode_from_env(default="strict")
     get_lock_registry().mode = guard_mode
 
+    # per-tier burn-rate monitor: always on (one throttled slo_burn record
+    # per emit interval); the brownout coupling below stays opt-in
+    from pytorch_distributed_training_tpu.telemetry.slo import (
+        BurnRateMonitor,
+        SloConfig,
+    )
+
+    slo = BurnRateMonitor(
+        SloConfig(
+            windows_s=tuple(
+                float(w) for w in args.slo_windows.split(",") if w.strip()
+            ),
+            emit_interval_s=args.slo_emit_s,
+        ),
+        registry=registry,
+    )
+
     brownout = None
     if args.brownout_high > 0:
         from pytorch_distributed_training_tpu.serve.queue import (
@@ -299,6 +335,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             deescalate_hold_s=args.brownout_deescalate_hold_s,
             clamp_max_new=args.brownout_clamp,
             registry=registry,
+            slo_monitor=slo if args.slo_burn_high > 0 else None,
+            slo_burn_high=args.slo_burn_high,
         )
     tier_deadlines = {}
     if args.interactive_deadline_s > 0:
@@ -318,6 +356,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         weights_step=boot_step,
         draft_model=draft_model,
         draft_params=draft_params,
+        slo=slo,
+        replica_name=args.replica_name,
     ).start()
 
     lock_summary = None
@@ -403,6 +443,9 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
                     f"deadline {args.drain_timeout_s:.1f}s)"
                 )
                 server.close(drain=True, timeout=args.drain_timeout_s)
+                # black-box dump: what the engine was doing when the
+                # preemption landed (the drain itself is the epilogue)
+                server.engine.flight.dump("sigterm_drain")
                 # let in-flight HTTP streams flush their final events
                 deadline = _time.monotonic() + 2.0
                 while (

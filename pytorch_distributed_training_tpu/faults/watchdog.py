@@ -18,6 +18,14 @@ checkpoint joins, host collectives in ``comms/collectives.py``):
 
 Sections that recover after a stall emit ``watchdog_recovered`` (a slow fs,
 a transient network partition) — stalls are evidence, aborts are policy.
+
+Guards NEST (PR-16): the serve engine arms a tick-wide ``serve_tick`` guard
+around the whole tick body while the dispatch/block sections inside arm
+their own (``serve_prefill``/``serve_decode``); the monitor watches the
+INNERMOST armed section — the most specific description of what is
+blocking. Stalls and aborts also dump every registered flight recorder
+(telemetry/flight.py), so a serve-side hang produces a tick timeline next
+to the stacks.
 """
 
 from __future__ import annotations
@@ -57,6 +65,17 @@ def _all_stacks() -> str:
     return text
 
 
+def _dump_flight(reason: str) -> None:
+    """Dump every registered flight recorder on the watchdog's failure
+    paths — best effort, never raises (the monitor must keep going)."""
+    try:
+        from pytorch_distributed_training_tpu.telemetry import flight
+
+        flight.dump_all(reason)
+    except Exception:  # pragma: no cover - failure-path best effort
+        pass
+
+
 class Watchdog:
     """One monitor thread per Trainer; ``guard`` is the only call site API.
 
@@ -83,7 +102,9 @@ class Watchdog:
         self.hard_timeout_s = hard_timeout_s
         self._exit = _exit
         self._cond = threading.Condition()
-        self._armed: dict | None = None
+        # stack of armed sections, outermost first; the monitor watches the
+        # innermost (last) entry
+        self._armed: list[dict] = []
         self._closed = False
         self._history: dict[str, deque] = {}
         self._thread: threading.Thread | None = None
@@ -128,7 +149,7 @@ class Watchdog:
             "stalled": False,
         }
         with self._cond:
-            self._armed = entry
+            self._armed.append(entry)
             self._cond.notify_all()
         try:
             yield
@@ -136,7 +157,8 @@ class Watchdog:
             duration = time.monotonic() - t0
             with self._cond:
                 stalled = entry["stalled"]
-                self._armed = None
+                if entry in self._armed:
+                    self._armed.remove(entry)
                 self._cond.notify_all()
             self.observe(what, duration)
             if stalled:
@@ -173,7 +195,7 @@ class Watchdog:
             with self._cond:
                 if self._closed:
                     return
-                entry = self._armed
+                entry = self._armed[-1] if self._armed else None
                 if entry is None:
                     self._cond.wait()
                     continue
@@ -216,6 +238,7 @@ class Watchdog:
                     "hard_timeout_s": self.hard_timeout_s,
                     "stacks": _all_stacks(),
                 })
+                _dump_flight("watchdog_stall")
                 logger.error(
                     "watchdog: section %r blocked for %.1fs (threshold "
                     "%.1fs) — possible hung collective/device; stacks "
@@ -243,6 +266,7 @@ class Watchdog:
             "exit_code": WATCHDOG_EXIT_CODE,
             "stacks": _all_stacks(),
         })
+        _dump_flight("watchdog_abort")
         sink = reg.sink
         if sink is not None:
             try:

@@ -68,6 +68,12 @@ class AutoscaleConfig:
     down_cooldown_s: float = 10.0
     #: background thread cadence (start()); step() callers pick their own
     poll_interval_s: float = 0.5
+    #: optional SLO coupling: when a BurnRateMonitor is attached to the
+    #: Autoscaler and its worst burn rate holds at/above this, the pool is
+    #: overloaded regardless of instantaneous queue depth (and is never
+    #: idle while burning). 0.0 = off — the default keeps queue/page
+    #: signals the sole policy, so BENCH_storm semantics are unchanged.
+    slo_burn_high: float = 0.0
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -91,9 +97,12 @@ class Autoscaler:
     """
 
     def __init__(self, fleet, config: Optional[AutoscaleConfig] = None, *,
-                 now_fn=None, registry=None):
+                 now_fn=None, registry=None, slo_monitor=None):
         self.fleet = fleet
         self.config = config or AutoscaleConfig()
+        # optional burn-rate input (telemetry/slo.py): read-only; only
+        # consulted when config.slo_burn_high > 0
+        self.slo_monitor = slo_monitor
         self._now = now_fn if now_fn is not None else time.monotonic
         if registry is None:
             from pytorch_distributed_training_tpu.telemetry.registry import (
@@ -141,6 +150,10 @@ class Autoscaler:
             "breakers_open": sum(
                 1 for r in views if r.breaker.state != "closed"
             ),
+            "slo_burn": (
+                self.slo_monitor.max_burn()
+                if self.slo_monitor is not None else 0.0
+            ),
         }
 
     # ----------------------------------------------------------------- step
@@ -164,14 +177,20 @@ class Autoscaler:
                 self._down_t = None
                 return None
 
+            burning = (
+                cfg.slo_burn_high > 0.0
+                and sig["slo_burn"] >= cfg.slo_burn_high
+            )
             overloaded = (
                 sig["mean_queue_depth"] >= cfg.scale_up_queue_depth
                 or sig["max_page_occupancy"] >= cfg.page_occupancy_high
+                or burning
             )
             idle = (
                 sig["mean_queue_depth"] <= cfg.scale_down_queue_depth
                 and sig["max_page_occupancy"] < cfg.page_occupancy_high
                 and sig["breakers_open"] == 0
+                and not burning
             )
 
             # hold timers: onset is remembered, leaving the band resets it

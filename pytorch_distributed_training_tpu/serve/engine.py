@@ -98,6 +98,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 import jax
@@ -205,6 +206,9 @@ class EngineConfig:
     # "float32" keeps today's exact baseline.
     weights_dtype: str = "float32"
     kv_dtype: str = "float32"
+    # Flight-recorder ring capacity (telemetry/flight.py): last N tick
+    # summaries kept for post-mortem dumps. Must be >= 1.
+    flight_capacity: int = 256
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -279,6 +283,10 @@ class EngineConfig:
             raise ValueError(
                 "kv_dtype='int8' requires kv_layout='paged' (the dense "
                 "cache has no scale-pool layout)"
+            )
+        if self.flight_capacity < 1:
+            raise ValueError(
+                f"flight_capacity must be >= 1, got {self.flight_capacity}"
             )
         if self.kv_layout == "paged" and self.num_pages > 0:
             if self.num_pages < self.pages_per_slot + 1:
@@ -397,6 +405,10 @@ class DecodeEngine:
         draft_model=None,
         draft_params=None,
         brownout=None,
+        tracer=None,
+        flight=None,
+        slo=None,
+        replica_name: Optional[str] = None,
     ):
         cfg = model.config
         if not cfg.causal:
@@ -685,6 +697,42 @@ class DecodeEngine:
         # idle ones — the serve loop re-ticks every idle-wait interval), so
         # /healthz can tell "loop wedged mid-tick" from "loop idle"
         self.last_tick_t = time.monotonic()
+        # ---- observability plane (PR-16)
+        # Request spans are emitted RETROACTIVELY at finish from the
+        # request's monotonic stamps (engine thread only), so the hot path
+        # adds counters, not emits.
+        self.replica_name = replica_name
+        if tracer is None:
+            from pytorch_distributed_training_tpu.telemetry.spans import (
+                Tracer,
+            )
+
+            tracer = Tracer(registry=registry, component=replica_name or "engine")
+        self.tracer = tracer
+        if flight is None:
+            from pytorch_distributed_training_tpu.telemetry.flight import (
+                FlightRecorder,
+            )
+
+            flight = FlightRecorder(
+                config.flight_capacity,
+                component=replica_name or "engine",
+                registry=registry,
+            )
+        self.flight = flight
+        from pytorch_distributed_training_tpu.telemetry import flight as _flight_mod
+
+        _flight_mod.register(self.flight)
+        # Optional burn-rate monitor: the finish path feeds it outcomes.
+        self.slo = slo
+        # Swap windows the engine has applied: [t0, t1, version, variant,
+        # outcome]. Engine-thread-only; requests whose lifetime intersects
+        # a window get a swap_overlap span.
+        self._swap_windows: deque = deque(maxlen=32)
+        # scratch: events collected during the current tick for the flight
+        # recorder entry (swap applied/committed/rollback, brownout moves)
+        self._tick_events: list = []
+        self._prev_brownout_level = 0
         if config.warmup:
             self._warmup()
 
@@ -1363,6 +1411,13 @@ class DecodeEngine:
         self.weights_step = version
         self._trial = (prev_params, prev_version, ticket)
         self._last_swap_variant = variant
+        # open swap window: closed by commit/rollback; requests whose
+        # lifetime intersects it get a swap_overlap span at finish
+        self._swap_windows.append({
+            "t0": time.monotonic(), "t1": None,
+            "version": version, "variant": variant, "outcome": "open",
+        })
+        self._tick_events.append(f"swap_applied:{version}")
         self._registry.inc("serve/swaps_applied")
         self._registry.emit({
             "record": "swap_applied",
@@ -1373,10 +1428,17 @@ class DecodeEngine:
             "variant": variant,
         })
 
+    def _close_swap_window(self, outcome: str) -> None:
+        if self._swap_windows and self._swap_windows[-1]["t1"] is None:
+            self._swap_windows[-1]["t1"] = time.monotonic()
+            self._swap_windows[-1]["outcome"] = outcome
+
     def _commit_swap(self) -> None:
         _prev, _prev_version, ticket = self._trial
         self._trial = None
         self.swaps += 1
+        self._close_swap_window("committed")
+        self._tick_events.append(f"swap_committed:{self.weights_step}")
         self._registry.inc("serve/swaps")
         self._registry.gauge("serve/weights_step", self.weights_step)
         self._registry.emit({
@@ -1399,6 +1461,8 @@ class DecodeEngine:
         self._params = prev_params
         self.weights_step = prev_version
         self.swap_rollbacks += 1
+        self._close_swap_window("rollback")
+        self._tick_events.append(f"swap_rollback:{failed_version}")
         self._registry.inc("serve/swap_rollbacks")
         self._registry.emit({
             "record": "swap_failed",
@@ -1482,6 +1546,79 @@ class DecodeEngine:
             "weights_step": self.weights_step,
         })
 
+    def _emit_spans(self, req: GenRequest) -> None:
+        """Retroactively emit the request's span tree from its monotonic
+        stamps (engine thread, at finish). The replica phases TILE the
+        request exactly — queue is submit→admit, prefill is admit→first
+        token, decode is first token→finish — so per-phase durations sum
+        to the serve span's total by construction (the bench's 5% gate).
+        A request that never left the queue gets a queue span covering its
+        whole life; ``admission`` (page reservation) nests under prefill;
+        ``swap_overlap``/``brownout_clamp`` annotate what touched it."""
+        tr = self.tracer
+        trace = req.id
+        base_attrs = {
+            "tier": req.tier,
+            "status": req.status,
+            "finish_reason": req.finish_reason,
+            "weights_step": self.weights_step,
+            "variant": self.variant,
+        }
+        if self.replica_name:
+            base_attrs["replica"] = self.replica_name
+        serve = tr.begin(
+            trace, "serve", parent=req.trace_parent, t0=req.submit_t,
+            attrs={**base_attrs, "bucket": req.bucket,
+                   "new_tokens": len(req.tokens)},
+        )
+        admit = req.admit_t
+        queue_end = admit if admit is not None else req.finish_t
+        q = tr.begin(trace, "queue", parent=serve.span, t0=req.submit_t,
+                     attrs={"tier": req.tier})
+        tr.end(q, t1=queue_end)
+        if admit is not None:
+            first = req.first_token_t
+            prefill_end = first if first is not None else req.finish_t
+            p = tr.begin(
+                trace, "prefill", parent=serve.span, t0=admit,
+                attrs={"bucket": req.bucket, "chunks": req.chunks},
+            )
+            if req.reserve_t is not None:
+                a = tr.begin(trace, "admission", parent=p.span, t0=admit,
+                             attrs={"pages": self._pages_for(req)
+                                    if self._pages is not None else 0})
+                tr.end(a, t1=req.reserve_t)
+            tr.end(p, t1=prefill_end)
+            if first is not None:
+                d = tr.begin(
+                    trace, "decode", parent=serve.span, t0=first,
+                    attrs={
+                        "ticks": req.decode_ticks,
+                        "tokens": len(req.tokens),
+                        "drafted": req.drafted,
+                        "accepted": req.accepted,
+                    },
+                )
+                tr.end(d, t1=req.finish_t)
+        if req.clamped_from is not None:
+            tr.event(
+                trace, "brownout_clamp", parent=serve.span, t=req.submit_t,
+                attrs={"from_max_new": req.clamped_from,
+                       "to_max_new": req.max_new_tokens},
+            )
+        for w in self._swap_windows:
+            hi = w["t1"] if w["t1"] is not None else req.finish_t
+            lo = max(w["t0"], req.submit_t)
+            hi = min(hi, req.finish_t)
+            if hi > lo:
+                s = tr.begin(
+                    trace, "swap_overlap", parent=serve.span, t0=lo,
+                    attrs={"version": w["version"], "variant": w["variant"],
+                           "outcome": w["outcome"]},
+                )
+                tr.end(s, t1=hi)
+        tr.end(serve, t1=req.finish_t)
+
     def _finish(self, req: GenRequest, status: str, reason: str) -> None:
         req.status = status
         req.finish_reason = reason
@@ -1489,6 +1626,18 @@ class DecodeEngine:
         self.finished += 1
         self._registry.inc(f"serve/finished_{status}")
         self._emit_request_record(req)
+        self._emit_spans(req)
+        if self.slo is not None and status != "cancelled":
+            # expired requests WERE served capacity-wise but missed their
+            # deadline; only hard errors count against availability here
+            # (sheds/rejections are fed by the front-end and router)
+            self.slo.observe(
+                req.tier,
+                available=status != "error",
+                deadline_met=(
+                    None if req.deadline_s is None else status == "done"
+                ),
+            )
         cb = req.on_finish
         if cb is not None:
             try:
@@ -1590,6 +1739,7 @@ class DecodeEngine:
         self.admitted += 1
         self._registry.inc("serve/admitted")
         self._pages.admit(slot, self._pages_for(req))
+        req.reserve_t = time.monotonic()
         self._slots[slot] = _Slot(
             request=req, pending_token=-1, phase="prefill",
             prefill_pos=0, spec=self._slot_spec(req),
@@ -1607,6 +1757,7 @@ class DecodeEngine:
         paged = self._pages is not None
         if paged:
             self._pages.admit(slot, self._pages_for(req))
+            req.reserve_t = time.monotonic()
         try:
             # ONE explicit H2D for all host-built operands (np → device);
             # under the strict tick-wide transfer scope, explicit
@@ -1725,6 +1876,7 @@ class DecodeEngine:
                     )
                 fetched = jax.device_get(out) if is_last else None
             self.prefill_chunks += 1
+            req.chunks += 1
             chunks += 1
             s.prefill_pos = end
             if is_last:
@@ -1885,9 +2037,12 @@ class DecodeEngine:
             s = self._slots[i]
             r = s.request
             a = int(accept[i]) if s.spec else 0
+            r.decode_ticks += 1
             if s.spec:
                 self.spec_drafted += k
                 self.spec_accepted += a
+                r.drafted += k
+                r.accepted += a
                 accepted += a
             finished = False
             for j in range(a + 1):
@@ -1945,11 +2100,16 @@ class DecodeEngine:
                         stage="apply",
                     )
         try:
-            if self._scope_ready():
-                with self._guards.transfer_scope("serve_tick"):
+            # tick-wide watchdog guard (nests over the inner prefill/decode
+            # guards): a hang ANYWHERE in the tick body — including the
+            # injected-fault hooks that fire outside dispatch sections —
+            # stalls a named section, which dumps the flight recorder
+            with watchdog_guard("serve_tick"):
+                if self._scope_ready():
+                    with self._guards.transfer_scope("serve_tick"):
+                        worked = self._tick_body()
+                else:
                     worked = self._tick_body()
-            else:
-                worked = self._tick_body()
         except Exception as e:
             if self._trial is not None:
                 self._rollback_swap(f"{type(e).__name__}: {e}")
@@ -2074,6 +2234,7 @@ class DecodeEngine:
             for i in active:
                 s = self._slots[i]
                 s.steps_done += 1
+                s.request.decode_ticks += 1
                 if sampled is not None:
                     token = int(sampled[i])
                 else:
@@ -2098,6 +2259,11 @@ class DecodeEngine:
         if self.brownout is not None:
             level = self.brownout.observe(depth / self._queue.max_depth)
             self._registry.gauge("serve/brownout_level", level)
+            if level != self._prev_brownout_level:
+                self._tick_events.append(
+                    f"brownout:{self._prev_brownout_level}->{level}"
+                )
+                self._prev_brownout_level = level
         now = time.monotonic()
         window = now - self._drain_window_t
         if window >= 1.0:
@@ -2113,6 +2279,30 @@ class DecodeEngine:
         if worked:
             self.busy_ticks += 1
             self._registry.observe("serve/tick", time.monotonic() - t0)
+        # flight-recorder entry for every busy or eventful tick — appended
+        # BEFORE the chaos hooks below, so a hang injected at this tick
+        # dumps a ring whose LAST entry is the stalled tick itself
+        events, self._tick_events = self._tick_events, []
+        if worked or events:
+            self.flight.record(
+                tick=self.ticks,
+                busy_tick=self.busy_ticks,
+                dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+                queue_depth=depth,
+                slots_active=sum(1 for s in self._slots if s is not None),
+                prefill_resident=self._prefill_resident(),
+                decode_active=len(active),
+                pages_used=(
+                    self._pages.pages_used if self._pages is not None else 0
+                ),
+                brownout=(
+                    self.brownout.level if self.brownout is not None else 0
+                ),
+                weights_step=self.weights_step,
+                finished=self.finished,
+                events=events,
+            )
+        if worked:
             # deterministic chaos hooks: slow_host:Nx stretches serving time
             # (deadline/backpressure drills); the replica_* kinds crash,
             # hang or slow THIS replica at an exact busy tick (router
@@ -2177,6 +2367,9 @@ class DecodeEngine:
             "brownout": (
                 self.brownout.stats() if self.brownout is not None else None
             ),
+            "spans_emitted": self.tracer.emitted,
+            **self.flight.stats(),
+            **(self.slo.stats() if self.slo is not None else {}),
             "num_slots": self.config.num_slots,
             "prompt_buckets": list(self.config.prompt_buckets),
             "compiled_prefill_buckets": sorted(self._prefill_fns),

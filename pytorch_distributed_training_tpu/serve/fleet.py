@@ -592,6 +592,7 @@ class ServeFleet:
         router_config: Optional[RouterConfig] = None,
         *,
         registry=None,
+        slo_monitor=None,
     ):
         if registry is None:
             from pytorch_distributed_training_tpu.telemetry.registry import (
@@ -615,6 +616,7 @@ class ServeFleet:
             [(r.name, fleet_config.host, r.port) for r in self.replicas],
             router_config,
             registry=registry,
+            slo_monitor=slo_monitor,
         )
         self.router.pool_status_fn = self.pool_status
         # pool membership changes (autoscaler scale-up/retire) vs the

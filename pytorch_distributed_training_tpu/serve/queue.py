@@ -108,6 +108,13 @@ class GenRequest:
     # spec and non-spec slots emit the same stream — so this is a latency
     # knob, not a quality one.
     spec: Optional[bool] = None
+    # Router-generated parent span id (X-Parent-Span header): the replica's
+    # ``serve`` span nests under the router attempt so hedged/retried
+    # attempts stay children of ONE trace.
+    trace_parent: Optional[str] = None
+    # Brownout clamp provenance: original max_new_tokens before the
+    # overload clamp rewrote it (None = never clamped).
+    clamped_from: Optional[int] = None
 
     # ---- engine-owned runtime state
     status: str = "new"      # new -> queued -> running -> done|expired|cancelled
@@ -116,8 +123,16 @@ class GenRequest:
     bucket: int = 0
     submit_t: float = 0.0
     admit_t: Optional[float] = None
+    # KV-page reservation stamp (just after pages.admit succeeds) — the
+    # ``admission`` span is admit_t -> reserve_t.
+    reserve_t: Optional[float] = None
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    # per-request engine accumulators feeding span attributes
+    decode_ticks: int = 0
+    chunks: int = 0          # chunked-prefill ticks consumed
+    drafted: int = 0         # speculative tokens drafted for this request
+    accepted: int = 0        # speculative tokens accepted for this request
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     @property
@@ -399,6 +414,8 @@ class BrownoutController:
         clamp_max_new: int = 16,
         now_fn=None,
         registry=None,
+        slo_monitor=None,
+        slo_burn_high: float = 0.0,
     ):
         if not 0.0 < low_watermark < high_watermark:
             raise ValueError(
@@ -416,6 +433,14 @@ class BrownoutController:
         self.clamp_max_new = clamp_max_new
         self._now = now_fn if now_fn is not None else time.monotonic
         self._registry = registry
+        # Optional SLO burn coupling (PR-16): when a BurnRateMonitor is
+        # attached AND slo_burn_high > 0, a burn rate at/above the
+        # threshold is treated as high watermark pressure regardless of
+        # instantaneous queue depth — budget burn escalates the ladder
+        # even when the queue looks shallow. Default-off (0.0) keeps the
+        # storm bench's semantics byte-identical.
+        self.slo_monitor = slo_monitor
+        self.slo_burn_high = float(slo_burn_high)
         self.level = 0
         self.escalations = 0
         self.deescalations = 0
@@ -430,6 +455,12 @@ class BrownoutController:
         current level. Crossing back into the hysteresis band resets both
         hold timers — only SUSTAINED pressure moves the ladder."""
         now = self._now()
+        if (
+            self.slo_monitor is not None
+            and self.slo_burn_high > 0.0
+            and self.slo_monitor.max_burn() >= self.slo_burn_high
+        ):
+            pressure = max(pressure, self.high_watermark)
         with self._lock:
             if pressure >= self.high_watermark:
                 self._below_t = None

@@ -237,7 +237,8 @@ class _Attempt:
     local event queue so the router can time TTFB, hedge and abandon."""
 
     def __init__(self, replica: Replica, body: bytes, rid: str,
-                 cfg: RouterConfig):
+                 cfg: RouterConfig,
+                 parent_span_id: Optional[str] = None):
         import queue as _q
 
         self.replica = replica
@@ -249,6 +250,7 @@ class _Attempt:
         )
         self._body = body
         self._rid = rid
+        self._parent_span_id = parent_span_id
         self._thread = threading.Thread(
             target=self._pump, name=f"router-attempt-{replica.name}",
             daemon=True,
@@ -257,10 +259,15 @@ class _Attempt:
 
     def _pump(self) -> None:
         try:
+            headers = {"X-Request-Id": self._rid,
+                       "Content-Type": "application/json"}
+            if self._parent_span_id:
+                # trace context: the replica parents its serve span under
+                # this attempt/hedge span, keeping retried and hedged
+                # attempts inside ONE trace tree
+                headers["X-Parent-Span"] = self._parent_span_id
             self._conn.request(
-                "POST", "/generate", body=self._body,
-                headers={"X-Request-Id": self._rid,
-                         "Content-Type": "application/json"},
+                "POST", "/generate", body=self._body, headers=headers,
             )
             resp = self._conn.getresponse()
             self.status = resp.status
@@ -300,7 +307,8 @@ class Router:
     """
 
     def __init__(self, endpoints, config: Optional[RouterConfig] = None,
-                 *, registry=None, _rng: Optional[random.Random] = None):
+                 *, registry=None, slo_monitor=None,
+                 _rng: Optional[random.Random] = None):
         self.config = config or RouterConfig()
         if registry is None:
             from pytorch_distributed_training_tpu.telemetry.registry import (
@@ -309,6 +317,14 @@ class Router:
 
             registry = get_registry()
         self._registry = registry
+        from pytorch_distributed_training_tpu.telemetry.spans import Tracer
+
+        # router-side spans: request (root) -> attempt -> hedge; replicas
+        # parent their serve spans under the attempt via X-Parent-Span
+        self.tracer = Tracer(registry=registry, component="router")
+        # optional burn-rate monitor: fed availability outcomes per routed
+        # request (rejections count against the tier's availability)
+        self.slo_monitor = slo_monitor
         self._rng = _rng or random.Random()
         self.replicas = [
             Replica(
@@ -583,6 +599,7 @@ class Router:
         t0 = time.monotonic()
         with self._lock:
             self.routed += 1
+        root = self.tracer.begin(rid, "request")
         attempts = 0
         hedged = False
         streamed = False
@@ -624,7 +641,18 @@ class Router:
                     self.config.retry_backoff_max_s,
                 )
                 time.sleep(backoff)
-            result = self._stream_attempt(replica, body, rid, write_line)
+            aspan = self.tracer.begin(
+                rid, "attempt", parent=root.span,
+                attrs={"replica": replica.name, "attempt": attempts},
+            )
+            result = self._stream_attempt(
+                replica, body, rid, write_line, parent_span=aspan,
+            )
+            self.tracer.end(aspan, attrs={
+                "ok": result["ok"],
+                "streamed": result.get("streamed", False),
+                "rejected": result.get("rejected", False),
+            })
             streamed = streamed or result.get("streamed", False)
             if result["ok"]:
                 outcome = {"status": "ok", "replica": replica.name}
@@ -663,6 +691,20 @@ class Router:
             self._registry.inc("router/attempt_errors")
 
         total_s = time.monotonic() - t0
+        self.tracer.end(root, attrs={
+            "status": outcome.get("status"),
+            "replica": outcome.get("replica"),
+            "attempts": attempts,
+            "hedged": hedged,
+        })
+        if self.slo_monitor is not None:
+            try:
+                tier = json.loads(body or b"{}").get("tier", "interactive")
+            except (json.JSONDecodeError, AttributeError):
+                tier = "interactive"
+            self.slo_monitor.observe(
+                tier, available=outcome.get("status") == "ok",
+            )
         served_by = next(
             (r for r in self._pool() if r.name == outcome.get("replica")),
             None,
@@ -687,10 +729,12 @@ class Router:
         return outcome
 
     def _stream_attempt(self, replica: Replica, body: bytes, rid: str,
-                        write_line) -> dict:
+                        write_line, *, parent_span=None) -> dict:
         """Run one attempt (plus an optional hedge) to completion."""
         cfg = self.config
-        primary = _Attempt(replica, body, rid, cfg)
+        parent_id = parent_span.span if parent_span is not None else None
+        primary = _Attempt(replica, body, rid, cfg,
+                           parent_span_id=parent_id)
         attempt, hedged, hedge_name = primary, False, None
         if cfg.hedge_s > 0:
             first = self._first_event(primary, cfg.hedge_s)
@@ -710,12 +754,23 @@ class Router:
                     })
                     with self._lock:
                         hedge_replica.requests += 1
-                    hedge = _Attempt(hedge_replica, body, rid, cfg)
+                    # hedge span: child of the SAME attempt, so both
+                    # replicas' serve spans land in one trace tree
+                    hspan = self.tracer.begin(
+                        rid, "hedge", parent=parent_id,
+                        attrs={"primary": replica.name,
+                               "hedge": hedge_replica.name},
+                    )
+                    hedge = _Attempt(hedge_replica, body, rid, cfg,
+                                     parent_span_id=hspan.span)
                     attempt, first = self._race(
                         primary, hedge, cfg.ttfb_timeout_s
                     )
                     if attempt is hedge:
                         hedge_name = hedge_replica.name
+                    self.tracer.end(hspan, attrs={
+                        "won": attempt is hedge,
+                    })
                 else:
                     first = self._first_event(
                         primary, max(0.0, cfg.ttfb_timeout_s - cfg.hedge_s)

@@ -79,6 +79,8 @@ class InferenceServer:
         weights_step: Optional[int] = None,
         draft_model=None,
         draft_params=None,
+        slo=None,
+        replica_name: Optional[str] = None,
     ):
         if tier_deadlines is not None:
             bad = set(tier_deadlines) - set(TIERS)
@@ -94,7 +96,7 @@ class InferenceServer:
             model, params, config, self.queue, registry=registry,
             guards=guards, weights_step=weights_step,
             draft_model=draft_model, draft_params=draft_params,
-            brownout=brownout,
+            brownout=brownout, slo=slo, replica_name=replica_name,
         )
         self.registry = self.engine._registry
         self.default_deadline_s = default_deadline_s
@@ -147,6 +149,12 @@ class InferenceServer:
                 "serve loop died; cancelling all in-flight requests"
             )
             self._loop_failed.set()     # /healthz: unhealthy, not draining
+            try:
+                # post-mortem timeline for the fatal tick (the exception
+                # says what broke; the ring says what led up to it)
+                self.engine.flight.dump("fatal_tick")
+            except Exception:  # pragma: no cover - best-effort post-mortem
+                pass
             self.queue.close()
             try:
                 self.engine.cancel_all()
@@ -183,6 +191,11 @@ class InferenceServer:
                 return
         if not drain:
             self.engine.cancel_all()
+        # a closed server's ring holds no future evidence: drop it from the
+        # process-wide dump_all set (direct .dump() calls still work)
+        from pytorch_distributed_training_tpu.telemetry import flight
+
+        flight.unregister(self.engine.flight)
 
     # ------------------------------------------------------------ submission
 
@@ -201,6 +214,8 @@ class InferenceServer:
         on_finish=None,
         request_id: Optional[str] = None,
         spec: Optional[bool] = None,
+        trace_parent: Optional[str] = None,
+        clamped_from: Optional[int] = None,
     ) -> GenRequest:
         """Enqueue one request (any thread). Raises ``BackpressureError``
         when the queue is full; the request's ``done`` event fires at every
@@ -221,6 +236,8 @@ class InferenceServer:
             stream=stream,
             on_finish=on_finish,
             spec=spec,
+            trace_parent=trace_parent,
+            clamped_from=clamped_from,
         )
         return self.queue.submit(req)
 
@@ -490,6 +507,14 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     })
             elif self.path == "/stats":
                 self._json(200, server.stats())
+            elif self.path == "/debug/flight":
+                # on-demand post-mortem: emit a flight_dump record on the
+                # metrics stream AND return the full ring to the caller
+                server.engine.flight.dump("debug_endpoint")
+                self._json(200, {
+                    "entries": server.engine.flight.snapshot(),
+                    **server.engine.flight.stats(),
+                })
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -541,6 +566,10 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                 # degraded, not the request). Both carry the live estimate.
                 level = brownout.level_name()
                 server.registry.inc(f"serve/shed_{tier}")
+                if server.engine.slo is not None:
+                    # a shed is an availability miss (the deadline ratio
+                    # only covers requests that were actually admitted)
+                    server.engine.slo.observe(tier, available=False)
                 server.registry.emit({
                     "record": "serve_shed",
                     "id": rid,
@@ -563,10 +592,12 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
             max_new = int(
                 msg.get("max_new_tokens", server.queue.max_new_tokens)
             )
+            clamped_from = None
             if brownout is not None:
                 clamped = brownout.clamp(max_new)
                 if clamped != max_new:
                     server.registry.inc("serve/brownout_clamped")
+                    clamped_from = max_new
                 max_new = clamped
             ids = tokenizer.text_ids(prompt)
             if not ids:
@@ -613,6 +644,11 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     stream=on_token,
                     on_finish=on_finish,
                     request_id=rid,
+                    # router attempt span id: the replica's serve span
+                    # parents under it, so hedged/retried attempts stay
+                    # children of ONE trace
+                    trace_parent=self.headers.get("X-Parent-Span"),
+                    clamped_from=clamped_from,
                 )
             except BackpressureError as e:
                 # backpressure is retryable BY CONSTRUCTION — say when

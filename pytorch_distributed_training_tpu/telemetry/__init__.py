@@ -11,9 +11,24 @@ The three pieces, wired together by the Trainer (train/loop.py):
 - ``epoch_straggler_stats`` (straggler.py) — cross-host step-time gather so
   process 0 can name the slowest host instead of just a slow fleet.
 
-``scripts/summarize_metrics.py`` folds a stream back into a per-epoch table.
+The serving observability plane layers on top of the same sink:
+
+- ``Tracer``/``Span`` (spans.py) — request-span tracing keyed by
+  ``X-Request-Id``; ``trace_coverage`` is the bench/test completeness
+  verdict;
+- ``FlightRecorder`` (flight.py) — ring-buffer of engine tick summaries
+  dumped as ``flight_dump`` records on watchdog stall, fatal tick,
+  SIGTERM and ``/debug/flight``;
+- ``BurnRateMonitor`` (slo.py) — per-tier multi-window SLO burn rates
+  (``slo_burn`` records + the optional autoscaler/brownout signal).
+
+``scripts/summarize_metrics.py`` folds a stream back into a per-epoch table;
+``scripts/trace_view.py`` renders one trace's waterfall + a fleet timeline.
 """
 
+from pytorch_distributed_training_tpu.telemetry.flight import (
+    FlightRecorder,
+)
 from pytorch_distributed_training_tpu.telemetry.registry import (
     MetricsRegistry,
     TimerStat,
@@ -23,6 +38,15 @@ from pytorch_distributed_training_tpu.telemetry.registry import (
 from pytorch_distributed_training_tpu.telemetry.sink import (
     JsonlSink,
     run_metadata,
+)
+from pytorch_distributed_training_tpu.telemetry.slo import (
+    BurnRateMonitor,
+    SloConfig,
+)
+from pytorch_distributed_training_tpu.telemetry.spans import (
+    Span,
+    Tracer,
+    trace_coverage,
 )
 from pytorch_distributed_training_tpu.telemetry.straggler import (
     epoch_straggler_stats,
@@ -36,4 +60,10 @@ __all__ = [
     "epoch_straggler_stats",
     "get_registry",
     "set_registry",
+    "Tracer",
+    "Span",
+    "trace_coverage",
+    "FlightRecorder",
+    "BurnRateMonitor",
+    "SloConfig",
 ]

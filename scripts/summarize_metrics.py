@@ -15,6 +15,12 @@ expired/cancelled counts. Hot-swap streams (serve/hotswap.py) add a
 steps, rollout convergence percentiles and the version-skew duration
 (from the router's ``router_skew`` spans).
 
+Traced streams (telemetry/spans.py) add a ``spans`` section — per-tier
+per-phase (queue/prefill/decode) p50/p95 plus the structural counts that
+gate the bench (orphan spans, incomplete traces) — an ``slo`` burn-rate
+table from the latest ``slo_burn`` record, and a flight-recorder dump
+inventory (``flight_dump`` records by reason).
+
     python scripts/summarize_metrics.py /path/to/metrics_dir
     python scripts/summarize_metrics.py /path/to/metrics.jsonl --json
 
@@ -27,6 +33,11 @@ import argparse
 import json
 import os
 import sys
+
+# the spans/slo sections lean on telemetry/spans.py for the structural
+# verdicts; running as `python scripts/summarize_metrics.py` puts scripts/
+# first on sys.path, so anchor the repo root explicitly
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_records(path: str) -> list[dict]:
@@ -119,6 +130,125 @@ def summarize(records: list[dict]) -> dict:
         "guards": guards,
         "locks": summarize_locks(records),
         "comm": summarize_comm(records),
+        "spans": summarize_spans(records),
+        "slo": summarize_slo(records),
+        "flight": summarize_flight(records),
+    }
+
+
+def summarize_spans(records: list[dict]) -> dict | None:
+    """Fold ``span`` records (telemetry/spans.py) into the tracing view:
+    per-tier per-phase latency percentiles over the replica phase spans,
+    plus the structural verdicts the bench gates on — orphan span count,
+    incomplete trace count and phase-sum reconciliation failures. None
+    when the stream holds no span records."""
+    from pytorch_distributed_training_tpu.telemetry.spans import (
+        REQUEST_PHASES,
+        trace_coverage,
+    )
+
+    spans = [r for r in records if r.get("record") == "span"]
+    if not spans:
+        return None
+    # tier rides the serve root's attrs; phase spans inherit it through
+    # their trace (one serve span per replica attempt)
+    tier_by_trace: dict[str, str] = {}
+    for s in spans:
+        if s.get("name") == "serve":
+            tier = (s.get("attrs") or {}).get("tier")
+            if tier:
+                tier_by_trace.setdefault(str(s.get("trace")), str(tier))
+    phases: dict[str, dict[str, list]] = {}
+    for s in spans:
+        if s.get("name") not in REQUEST_PHASES:
+            continue
+        tier = tier_by_trace.get(str(s.get("trace")), "?")
+        phases.setdefault(tier, {p: [] for p in REQUEST_PHASES})
+        phases[tier][s["name"]].append(s.get("dur_s"))
+    coverage = trace_coverage(records)
+    return {
+        "spans": len(spans),
+        "traces": coverage["traces"],
+        "complete_traces": coverage["complete"],
+        "incomplete_traces": len(coverage["incomplete"]),
+        "orphan_spans": coverage["orphan_spans"],
+        "phase_sum_bad": len(coverage["phase_sum_bad"]),
+        "coverage": coverage["coverage"],
+        "tiers": {
+            tier: {
+                phase: _pcts(vals)
+                for phase, vals in phases[tier].items()
+            }
+            for tier in sorted(phases)
+        },
+        "components": sorted({
+            s.get("component") or "?" for s in spans
+        }),
+        "hedges": sum(1 for s in spans if s.get("name") == "hedge"),
+        "attempts": sum(1 for s in spans if s.get("name") == "attempt"),
+    }
+
+
+def summarize_slo(records: list[dict]) -> dict | None:
+    """The latest ``slo_burn`` record per stream (the monitor emits
+    cumulative window views, so the newest one IS the summary), reshaped
+    into a per-tier per-window burn table. None when the stream holds no
+    burn records."""
+    burns = [r for r in records if r.get("record") == "slo_burn"]
+    if not burns:
+        return None
+    last = burns[-1]
+    tiers = {}
+    for tier, windows in (last.get("tiers") or {}).items():
+        tiers[tier] = {
+            label: {
+                "requests": w.get("requests"),
+                "deadline_met": w.get("deadline_met"),
+                "availability": w.get("availability"),
+                "deadline_burn": w.get("deadline_burn"),
+                "availability_burn": w.get("availability_burn"),
+            }
+            for label, w in windows.items()
+        }
+    return {
+        "emissions": len(burns),
+        "windows_s": last.get("windows_s"),
+        "deadline_objective": last.get("deadline_objective"),
+        "availability_objective": last.get("availability_objective"),
+        "max_burn": last.get("max_burn"),
+        "peak_burn": max(
+            (r.get("max_burn") or 0.0 for r in burns), default=0.0
+        ),
+        "tiers": tiers,
+    }
+
+
+def summarize_flight(records: list[dict]) -> dict | None:
+    """Inventory of flight-recorder dumps (telemetry/flight.py): how many
+    rings were dumped, for which reasons, and the last tick each dump
+    captured (the stalled tick when the reason is a watchdog). None when
+    the stream holds no dumps."""
+    dumps = [r for r in records if r.get("record") == "flight_dump"]
+    if not dumps:
+        return None
+    by_reason: dict[str, int] = {}
+    for r in dumps:
+        reason = r.get("reason") or "?"
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+    detail = []
+    for r in dumps:
+        entries = r.get("entries") or []
+        detail.append({
+            "component": r.get("component"),
+            "reason": r.get("reason"),
+            "depth": r.get("depth"),
+            "dropped": r.get("dropped"),
+            "last_tick": entries[-1].get("tick") if entries else None,
+        })
+    return {
+        "dumps": len(dumps),
+        "by_reason": by_reason,
+        "detail": detail,
     }
 
 
@@ -884,6 +1014,84 @@ def render_comm_table(comm: dict) -> str:
     return "\n".join(lines)
 
 
+def render_spans_table(spans: dict) -> str:
+    """Per-tier per-phase latency rows + the structural-verdict footer
+    (the tracing view of a spanned stream)."""
+    def ms(block: dict | None, key: str):
+        return (
+            block[key] * 1e3
+            if block and block.get(key) is not None else None
+        )
+
+    cols = ["tier", "phase", "count", "p50 ms", "p95 ms", "p99 ms"]
+    rows = []
+    for tier in sorted(spans["tiers"]):
+        for phase, block in spans["tiers"][tier].items():
+            rows.append([
+                tier, phase, _fmt(block["count"] if block else 0),
+                _fmt(ms(block, "p50")), _fmt(ms(block, "p95")),
+                _fmt(ms(block, "p99")),
+            ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "spans:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    structural_bad = (
+        spans["orphan_spans"] or spans["incomplete_traces"]
+        or spans["phase_sum_bad"]
+    )
+    lines.append(
+        f"traces={spans['traces']} complete={spans['complete_traces']} "
+        f"incomplete={spans['incomplete_traces']} "
+        f"orphan-spans={spans['orphan_spans']} "
+        f"phase-sum-bad={spans['phase_sum_bad']} "
+        f"attempts={spans['attempts']} hedges={spans['hedges']}"
+        + (" [INCOMPLETE]" if structural_bad else " [complete]")
+    )
+    return "\n".join(lines)
+
+
+def render_slo_table(slo: dict) -> str:
+    """Per-tier per-window burn rows from the stream's latest
+    ``slo_burn`` record."""
+    cols = ["tier", "window", "reqs", "deadline-met", "avail",
+            "deadline-burn", "avail-burn"]
+    rows = []
+    for tier in sorted(slo["tiers"]):
+        for label, w in slo["tiers"][tier].items():
+            rows.append([
+                tier, label, _fmt(w["requests"]),
+                _fmt(w["deadline_met"], ".3f"),
+                _fmt(w["availability"], ".3f"),
+                _fmt(w["deadline_burn"], ".2f"),
+                _fmt(w["availability_burn"], ".2f"),
+            ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(cols)
+    ]
+    lines = [
+        "slo:",
+        "  ".join(h.rjust(w) for h, w in zip(cols, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    lines.append(
+        f"objectives: deadline={_fmt(slo['deadline_objective'])} "
+        f"availability={_fmt(slo['availability_objective'])}  "
+        f"max-burn={_fmt(slo['max_burn'], '.2f')} "
+        f"peak-burn={_fmt(slo['peak_burn'], '.2f')}"
+        + (" [BURNING]" if (slo["max_burn"] or 0) > 1.0 else " [ok]")
+    )
+    return "\n".join(lines)
+
+
 def render_table(summary: dict) -> str:
     cols = [
         ("epoch", "epoch"),
@@ -951,6 +1159,21 @@ def render_table(summary: dict) -> str:
             f"/{swap['rollouts_converged']} converged "
             f"(p95 {_fmt(ro.get('p95'))}s) "
             f"skew={_fmt(swap.get('skew_s'))}s"
+        )
+    spans = summary.get("spans")
+    if spans:
+        lines.append(render_spans_table(spans))
+    slo = summary.get("slo")
+    if slo:
+        lines.append(render_slo_table(slo))
+    flight = summary.get("flight")
+    if flight:
+        reasons = ",".join(
+            f"{k}={v}" for k, v in sorted(flight["by_reason"].items())
+        )
+        lines.append(
+            f"flight-dumps: {flight['dumps']} ({reasons}) "
+            f"last-ticks={[d['last_tick'] for d in flight['detail']]}"
         )
     locks = summary.get("locks")
     if locks:

@@ -1,0 +1,254 @@
+"""Render request-span traces and the fleet event timeline from a
+metrics dir.
+
+The span plane (telemetry/spans.py) writes ``span`` records into the same
+JSONL streams everything else uses: the coordinator's ``metrics.jsonl``
+holds the router's ``request``/``attempt``/``hedge`` spans, each
+``replica-*/metrics.jsonl`` holds that replica's ``serve`` trees. This
+tool merges them fleet-side (by trace id — the ``X-Request-Id``) and
+prints either:
+
+- **a waterfall** for one trace (``--trace <id>``): the span tree indented
+  by parentage, each row with its start offset from the root and its
+  duration, plus the span's salient attributes — the "where did THIS
+  request spend its time" view; or
+- **the fleet timeline** (default): every operational event in the merged
+  streams — scale actions, swap rollouts, brownout transitions, flight
+  dumps, watchdog stalls, SLO burn emissions — in wall-clock order, with
+  a trace inventory footer.
+
+    python scripts/trace_view.py /path/to/metrics_dir
+    python scripts/trace_view.py /path/to/metrics_dir --trace <request-id>
+    python scripts/trace_view.py /path/to/metrics_dir --traces   # list ids
+
+Offsets in the waterfall use the emit-time wall stamps (``wall_t0``):
+within one process they are exact, across processes they are aligned only
+as well as the hosts' clocks — good enough to SEE a hedge race, never
+used for duration arithmetic (durations come from monotonic bounds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_training_tpu.telemetry.spans import (
+    spans_by_trace,
+    trace_summary,
+)
+
+#: fleet-timeline record types worth a row, with the fields shown per type
+TIMELINE_RECORDS = {
+    "fleet_scale": ("action", "replica", "size", "drain_s"),
+    "autoscale_event": ("action", "replica", "mean_queue_depth", "slo_burn"),
+    "autoscale_ready": ("replica", "ready_s"),
+    "swap_admitted": ("step",),
+    "swap_ok": ("step", "load_s"),
+    "swap_failed": ("step", "error"),
+    "swap_rollback": ("step",),
+    "fleet_swap": ("step", "converged", "duration_s"),
+    "brownout_transition": ("from", "to", "level"),
+    "flight_dump": ("component", "reason", "depth", "dropped"),
+    "watchdog_stall": ("name", "stalled_s"),
+    "watchdog_abort": ("name",),
+    "slo_burn": ("max_burn",),
+    "serve_shed": ("tier", "reason"),
+}
+
+
+def load_file(path: str) -> list[dict]:
+    """Parse one metrics JSONL file, skipping torn lines (a crashed
+    writer's final partial record) rather than failing the view."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: skipping unparseable line: {line[:80]}",
+                      file=sys.stderr)
+    return records
+
+
+def load_dir(path: str) -> list[dict]:
+    """Merge a metrics dir's streams: the coordinator's ``metrics.jsonl``
+    plus every ``replica-*/metrics.jsonl`` under it (the fleet layout
+    cli/fleet_lm.py writes). A plain file path loads just that file."""
+    if os.path.isfile(path):
+        return load_file(path)
+    paths = []
+    top = os.path.join(path, "metrics.jsonl")
+    if os.path.isfile(top):
+        paths.append(top)
+    paths += sorted(glob.glob(os.path.join(path, "replica-*",
+                                           "metrics.jsonl")))
+    if not paths:
+        raise FileNotFoundError(f"no metrics.jsonl under {path}")
+    records = []
+    for p in paths:
+        records += load_file(p)
+    return records
+
+
+# ------------------------------------------------------------- waterfall
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}ms"
+
+
+def _attr_line(span: dict) -> str:
+    attrs = span.get("attrs") or {}
+    keep = [
+        (k, attrs[k]) for k in sorted(attrs)
+        if attrs[k] is not None and attrs[k] != ""
+    ]
+    return " ".join(f"{k}={v}" for k, v in keep)
+
+
+def render_waterfall(records, trace_id: str) -> str:
+    """The span tree for one trace, children indented under parents and
+    ordered by wall start; orphans (parents outside the merged streams)
+    surface under their own heading instead of vanishing."""
+    spans = spans_by_trace(records).get(str(trace_id))
+    if not spans:
+        return f"trace {trace_id}: no spans found"
+    verdict = trace_summary(spans)
+    by_id = {s.get("span"): s for s in spans}
+    children: dict = {}
+    roots, orphans = [], []
+    for s in spans:
+        parent = s.get("parent")
+        if not parent:
+            roots.append(s)
+        elif parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            orphans.append(s)
+
+    def start_key(s: dict):
+        return (s.get("wall_t0") or 0.0, s.get("t0_s") or 0.0)
+
+    base = min((start_key(s)[0] for s in spans), default=0.0)
+    lines = [
+        f"trace {trace_id}: {verdict['spans']} span(s), "
+        + ("complete" if verdict["complete"] else
+           f"INCOMPLETE (roots={verdict['roots']} "
+           f"orphans={verdict['orphans']} open={verdict['open']})")
+        + (f", phases {'ok' if verdict['phase_sum_ok'] else 'DIVERGE'}"
+           f" ({_fmt_ms(verdict['phase_sum_s'])}"
+           f" of {_fmt_ms(verdict['serve_dur_s'])} serve)"
+           if verdict["phase_sum_ok"] is not None else ""),
+    ]
+
+    def walk(span: dict, depth: int) -> None:
+        offset = (span.get("wall_t0") or base) - base
+        name = "  " * depth + span.get("name", "?")
+        attrs = _attr_line(span)
+        lines.append(
+            f"  {name:<24} {span.get('component') or '-':<12} "
+            f"+{offset * 1e3:8.1f}ms  {_fmt_ms(span.get('dur_s')):>10}"
+            + (f"  {attrs}" if attrs else "")
+        )
+        for child in sorted(children.get(span.get("span"), []),
+                            key=start_key):
+            walk(child, depth + 1)
+
+    for root in sorted(roots, key=start_key):
+        walk(root, 0)
+    if orphans:
+        lines.append("  orphans (parent span not in merged streams):")
+        for s in sorted(orphans, key=start_key):
+            attrs = _attr_line(s)
+            lines.append(
+                f"    {s.get('name', '?'):<22} "
+                f"{s.get('component') or '-':<12} "
+                f"parent={s.get('parent')}  {_fmt_ms(s.get('dur_s')):>10}"
+                + (f"  {attrs}" if attrs else "")
+            )
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------- fleet timeline
+
+
+def render_timeline(records) -> str:
+    """Operational events across the merged streams in sink-timestamp
+    order, plus a trace inventory footer (how many traces the streams
+    hold and how many merge into complete trees)."""
+    events = [
+        r for r in records if r.get("record") in TIMELINE_RECORDS
+    ]
+    events.sort(key=lambda r: r.get("ts") or 0.0)
+    t0 = next((r["ts"] for r in events if r.get("ts") is not None), None)
+    lines = ["fleet timeline:"]
+    if not events:
+        lines.append("  (no operational events in stream)")
+    for r in events:
+        at = (f"+{r['ts'] - t0:8.1f}s"
+              if t0 is not None and r.get("ts") is not None else "        ?")
+        detail = " ".join(
+            f"{k}={r[k]}" for k in TIMELINE_RECORDS[r["record"]]
+            if r.get(k) is not None
+        )
+        lines.append(f"  {at}  {r['record']:<19} {detail}")
+
+    traces = spans_by_trace(records)
+    if traces:
+        complete = sum(
+            1 for s in traces.values() if trace_summary(s)["complete"]
+        )
+        lines.append(
+            f"traces: {len(traces)} ({complete} complete) — "
+            f"re-run with --trace <id> for a waterfall"
+        )
+    return "\n".join(lines)
+
+
+def render_trace_list(records) -> str:
+    """One row per trace: id, span count, completeness, root duration."""
+    traces = spans_by_trace(records)
+    if not traces:
+        return "no span records in stream"
+    lines = ["traces:"]
+    for trace in sorted(traces):
+        v = trace_summary(traces[trace])
+        lines.append(
+            f"  {trace:<36} spans={v['spans']:<3} "
+            f"{'complete' if v['complete'] else 'INCOMPLETE':<10} "
+            f"root={v['root_name'] or '?'} "
+            f"dur={_fmt_ms(v['root_dur_s'])}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> str:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("path", help="metrics dir (fleet layout) or one "
+                               "metrics.jsonl")
+    p.add_argument("--trace", help="render the waterfall for this trace "
+                                   "id (the request's X-Request-Id)")
+    p.add_argument("--traces", action="store_true",
+                   help="list every trace id in the merged streams")
+    args = p.parse_args(argv)
+    records = load_dir(args.path)
+    if args.trace:
+        out = render_waterfall(records, args.trace)
+    elif args.traces:
+        out = render_trace_list(records)
+    else:
+        out = render_timeline(records)
+    print(out)
+    return out
+
+
+if __name__ == "__main__":
+    main()
