@@ -114,6 +114,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(~0.3x KV bytes/token at head_dim 16; allocator "
                         "and admission arithmetic unchanged). Requires "
                         "--kv-layout paged")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="shared-KV prefix cache: finished prompts' pages "
+                        "are indexed in a token-keyed trie and a matching "
+                        "prompt prefix is served from the cache (refcounted "
+                        "pages, copy-on-write at the divergence point) — "
+                        "only the tail is prefilled, streams bit-identical "
+                        "to cold prefill. Requires --kv-layout paged + "
+                        "device sampling; a weight hot-swap flushes the "
+                        "index")
+    p.add_argument("--tenant-page-quota", type=float, default=0.0,
+                   help="per-tenant PRIVATE-page ceiling as a fraction of "
+                        "the page pool (0 = unlimited): requests carrying "
+                        "a tenant are held at admission once their "
+                        "tenant's non-shared footprint would exceed it — "
+                        "shared prefix pages stay free, so no tenant can "
+                        "monopolize the pool. Requires --prefix-cache")
     p.add_argument("--warmup", action="store_true",
                    help="compile every prefill bucket + the decode step "
                         "before serving (first request pays no compile; "
@@ -269,6 +285,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
             "tp": args.tp,
             "weights_dtype": args.weights_dtype,
             "kv_dtype": args.kv_dtype,
+            "prefix_cache": args.prefix_cache,
+            "tenant_page_quota": args.tenant_page_quota,
         })
 
     config = EngineConfig(
@@ -288,6 +306,8 @@ def main(argv=None, in_stream=None, out_stream=None) -> dict:
         tp=args.tp,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
+        prefix_cache=args.prefix_cache,
+        tenant_page_quota=args.tenant_page_quota,
         flight_capacity=args.flight_capacity,
     )
     from pytorch_distributed_training_tpu.analysis.concurrency import (

@@ -34,8 +34,15 @@ the repo with no way to SERVE a model; this package is that missing half:
                 (grows via the spawn machinery, shrinks via the graceful
                 SIGTERM/exit-75 drain — no in-flight request dies);
 - ``trace``   — seeded open-loop traffic traces (Poisson base + burst
-                episodes, heavy-tailed sizes, SLO tiers) and the replay
+                episodes, heavy-tailed sizes, SLO tiers, optional
+                multi-tenant shared-system-prompt mix) and the replay
                 driver behind ``bench.py --storm``;
+- ``prefix_cache`` — shared-KV prefix cache: a token-keyed trie over
+                finished prompts' fully-written page runs; a matching
+                request maps the shared pages into its block table
+                (refcounted, copy-on-write at the divergence point) and
+                prefills only the tail — cached streams stay bit-identical
+                to cold prefill, and a weight hot-swap flushes the index;
 - ``hotswap`` — zero-downtime checkpoint hot-swap: a manifest-verified
                 watcher admits newly published steps (never twice, never
                 backwards, poisoned steps blocklisted), the replica-side
@@ -66,6 +73,10 @@ from pytorch_distributed_training_tpu.serve.queue import (
     BrownoutController,
     GenRequest,
     RequestQueue,
+)
+from pytorch_distributed_training_tpu.serve.prefix_cache import (
+    PrefixCache,
+    PrefixMatch,
 )
 from pytorch_distributed_training_tpu.serve.fleet import (
     FleetConfig,
@@ -108,6 +119,8 @@ __all__ = [
     "GenRequest",
     "HotSwapManager",
     "InferenceServer",
+    "PrefixCache",
+    "PrefixMatch",
     "RequestQueue",
     "RollingSwapCoordinator",
     "Router",

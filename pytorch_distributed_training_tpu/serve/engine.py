@@ -206,6 +206,21 @@ class EngineConfig:
     # "float32" keeps today's exact baseline.
     weights_dtype: str = "float32"
     kv_dtype: str = "float32"
+    # Shared-KV prefix cache (serve/prefix_cache.py): finished prompts'
+    # fully-written pages are indexed in a token-keyed trie, and a later
+    # request with a matching prompt prefix maps those pages into its block
+    # table (refcount bumped) and prefills only the tail — the tail streams
+    # through the chunked-prefill program starting at the cached boundary.
+    # Streams stay bit-identical to cold prefill (pinned by tests).
+    # Requires kv_layout="paged" + sampling="device".
+    prefix_cache: bool = False
+    # Per-tenant page quota as a fraction of the pool (0 = unlimited): a
+    # tenant whose PRIVATE (non-shared) page footprint would exceed
+    # quota * (num_pages - 1) is held at admission — shared prefix pages
+    # are free, so one tenant cannot monopolize the pool with private
+    # state while everyone shares the cached prefixes. Requires
+    # prefix_cache=True (quota accounting rides its admission path).
+    tenant_page_quota: float = 0.0
     # Flight-recorder ring capacity (telemetry/flight.py): last N tick
     # summaries kept for post-mortem dumps. Must be >= 1.
     flight_capacity: int = 256
@@ -283,6 +298,24 @@ class EngineConfig:
             raise ValueError(
                 "kv_dtype='int8' requires kv_layout='paged' (the dense "
                 "cache has no scale-pool layout)"
+            )
+        if self.prefix_cache:
+            # cache hits prefill their tail through the multi-token-query
+            # chunk program with in-jit sampling, same substrate as
+            # spec_k/prefill_chunk
+            if self.kv_layout != "paged":
+                raise ValueError("prefix_cache requires kv_layout='paged'")
+            if self.sampling != "device":
+                raise ValueError("prefix_cache requires sampling='device'")
+        if not 0.0 <= self.tenant_page_quota <= 1.0:
+            raise ValueError(
+                f"tenant_page_quota must be in [0, 1], got "
+                f"{self.tenant_page_quota}"
+            )
+        if self.tenant_page_quota > 0.0 and not self.prefix_cache:
+            raise ValueError(
+                "tenant_page_quota requires prefix_cache=True (quota "
+                "accounting rides the prefix-cache admission path)"
             )
         if self.flight_capacity < 1:
             raise ValueError(
@@ -490,7 +523,10 @@ class DecodeEngine:
         # A separate view — not a flag flip on _decode_model — so the
         # chunk==1 decode program and its bitwise pins are untouched.
         self._mq_model = None
-        if paged and (config.spec_k > 0 or config.prefill_chunk > 0):
+        if paged and (
+            config.spec_k > 0 or config.prefill_chunk > 0
+            or config.prefix_cache
+        ):
             self._mq_model = type(model)(
                 dataclasses.replace(dcfg, paged_multiquery=True)
             )
@@ -539,7 +575,7 @@ class DecodeEngine:
                 ),
             )
             self._draft_model = type(draft_model)(ddcfg)
-            if config.prefill_chunk > 0:
+            if config.prefill_chunk > 0 or config.prefix_cache:
                 self._draft_mq_model = type(draft_model)(
                     dataclasses.replace(ddcfg, paged_multiquery=True)
                 )
@@ -603,6 +639,9 @@ class DecodeEngine:
             mode=guard_mode_from_env(), registry=registry
         )
 
+        # Shared-KV prefix cache (config.prefix_cache): trie over finished
+        # prompts' fully-written page runs, built beside the allocator below.
+        self._prefix = None
         if paged:
             # Page pools are shaped by config, not by the init input; the
             # abstract init only discovers the cache tree structure. The
@@ -629,6 +668,12 @@ class DecodeEngine:
                 config.total_pages, config.page_size,
                 config.pages_per_slot, config.num_slots,
             )
+            if config.prefix_cache:
+                from pytorch_distributed_training_tpu.serve.prefix_cache import (  # noqa: E501
+                    PrefixCache,
+                )
+
+                self._prefix = PrefixCache(self._pages)
             if self._draft_model is not None:
                 dshapes = jax.eval_shape(
                     lambda: self._draft_model.init(
@@ -666,6 +711,17 @@ class DecodeEngine:
         self._draft_decode_fn_ = None   # spec_draft="model" programs
         self._draft_prefill_fns: dict[int, object] = {}
         self._draft_chunk_fn_ = None
+        self._copy_fn_ = None           # prefix_cache: COW page-copy program
+        self._draft_copy_fn_ = None
+        # Chunk-program width: prefill_chunk when chunked prefill is on;
+        # a prefix-cache engine without it still needs the chunk program
+        # for cache-hit TAIL prefills (which start at a nonzero context the
+        # monolithic per-bucket programs cannot express) and uses one page
+        # of tokens per tick.
+        self._chunk_size = (
+            config.prefill_chunk if config.prefill_chunk > 0
+            else config.page_size
+        )
         # speculation / chunked-prefill accounting (stats() + telemetry)
         self.spec_dispatches = 0        # verify dispatches executed
         self.spec_drafted = 0           # draft tokens proposed
@@ -673,6 +729,16 @@ class DecodeEngine:
         self.decode_dispatches = 0      # decode-phase dispatches (any kind)
         self.decode_tokens = 0          # tokens emitted by decode-phase work
         self.prefill_chunks = 0         # chunk dispatches executed
+        # prefix-cache accounting. prefill_tokens counts REAL prompt tokens
+        # actually pushed through a prefill program (monolithic or chunk) —
+        # the bench's cached-vs-cold reduction numerator — and is kept even
+        # with the cache off so A/B runs compare like with like.
+        self.prefill_tokens = 0
+        self.cow_copies = 0             # COW page copies dispatched
+        self.tenant_blocked = 0         # admissions held by tenant quota
+        self._tenant_pages: dict[str, int] = {}  # tenant -> private pages
+        self._slot_charge: dict[int, tuple] = {}  # slot -> (tenant, pages)
+        self._match_scratch = None      # (req_id, PrefixMatch) from accept
         self._last_logits = np.zeros(
             (config.num_slots, cfg.vocab_size), np.float32
         )
@@ -1056,7 +1122,7 @@ class DecodeEngine:
         """
         if self._chunk_fn_ is not None:
             return self._chunk_fn_
-        C = self.config.prefill_chunk
+        C = self._chunk_size
 
         def chunk(params, pools, ids, ctx0, sample_idx, bt_row, seed, temp,
                   top_k):
@@ -1151,7 +1217,7 @@ class DecodeEngine:
         """Chunked-prefill mirror into the DRAFT pools (no sampling)."""
         if self._draft_chunk_fn_ is not None:
             return self._draft_chunk_fn_
-        C = self.config.prefill_chunk
+        C = self._chunk_size
 
         def draft_chunk(params, pools, ids, ctx0, bt_row):
             params = dequantize_serve_params(params)
@@ -1172,6 +1238,70 @@ class DecodeEngine:
         )
         return self._draft_chunk_fn_
 
+    @staticmethod
+    def _page_copy(pools, src, dst):
+        """Copy page ``src`` onto page ``dst`` in every pool leaf. The page
+        axis leads every paged leaf — rank-4 K/V pools and (int8 cache)
+        rank-3 scale pools alike — and is never sharded under tp (pools
+        split on the heads axis only), so one shard-local gather/scatter
+        covers every dtype and tp variant."""
+        return jax.tree.map(lambda leaf: leaf.at[dst].set(leaf[src]), pools)
+
+    def _copy_fn(self):
+        """Jitted copy-on-write page copy over the BASE pools: a cache hit
+        whose divergence point falls mid-page clones the partially-matching
+        shared page into the slot's fresh private page before the tail
+        prefill's first write (a slot never writes a page with
+        refcount > 1). The stale lanes past the cached boundary are masked
+        by ``context_len`` and overwritten by the tail prefill — the same
+        dead-lane argument as prefill padding."""
+        if self._copy_fn_ is not None:
+            return self._copy_fn_
+        self._copy_fn_ = self._guards.wrap_jit(
+            "serve_cow_copy",
+            jax.jit(self._page_copy, donate_argnums=(0,)),
+            audit_donation=True,
+        )
+        return self._copy_fn_
+
+    def _draft_copy_fn(self):
+        """COW page copy over the DRAFT pools (spec_draft="model"): the
+        shared block-table row addresses both pool sets, so a repointed
+        entry needs the draft-side K/V cloned too."""
+        if self._draft_copy_fn_ is not None:
+            return self._draft_copy_fn_
+        self._draft_copy_fn_ = self._guards.wrap_jit(
+            "serve_draft_cow_copy",
+            jax.jit(self._page_copy, donate_argnums=(0,)),
+            audit_donation=True,
+        )
+        return self._draft_copy_fn_
+
+    def _warm_chunk(self, draft: bool):
+        """Compile + null-run the chunk program (and its draft mirror)."""
+        cfg = self.config
+        W = cfg.pages_per_slot
+        ops = self._put((
+            np.zeros((1, self._chunk_size), np.int32),
+            np.zeros((1,), np.int32),
+            np.int32(0),
+            np.zeros((1, W), np.int32),
+            np.int32(0), np.float32(0.0), np.int32(0),
+        ))
+        out, self._cache = self._chunk_fn()(
+            self._params, self._cache, *ops
+        )
+        if draft:
+            dops = self._put((
+                np.zeros((1, self._chunk_size), np.int32),
+                np.zeros((1,), np.int32),
+                np.zeros((1, W), np.int32),
+            ))
+            self._draft_cache = self._draft_chunk_fn()(
+                self._draft_params, self._draft_cache, *dops
+            )
+        return out
+
     def _warmup(self) -> None:
         """Compile every serving program (one prefill per bucket + the
         decode step) with null operands before the engine goes live.
@@ -1187,26 +1317,7 @@ class DecodeEngine:
         outs = []
         if paged and cfg.prefill_chunk > 0:
             # ONE chunk program replaces the whole per-bucket prefill set
-            ops = self._put((
-                np.zeros((1, cfg.prefill_chunk), np.int32),
-                np.zeros((1,), np.int32),
-                np.int32(0),
-                np.zeros((1, W), np.int32),
-                np.int32(0), np.float32(0.0), np.int32(0),
-            ))
-            out, self._cache = self._chunk_fn()(
-                self._params, self._cache, *ops
-            )
-            outs.append(out)
-            if draft:
-                dops = self._put((
-                    np.zeros((1, cfg.prefill_chunk), np.int32),
-                    np.zeros((1,), np.int32),
-                    np.zeros((1, W), np.int32),
-                ))
-                self._draft_cache = self._draft_chunk_fn()(
-                    self._draft_params, self._draft_cache, *dops
-                )
+            outs.append(self._warm_chunk(draft))
         else:
             for bucket in cfg.prompt_buckets:
                 if paged:
@@ -1235,6 +1346,18 @@ class DecodeEngine:
                     self._draft_cache = self._draft_prefill_fn(bucket)(
                         self._draft_params, self._draft_cache, *dops
                     )
+        if paged and cfg.prefix_cache:
+            if cfg.prefill_chunk == 0:
+                # cold prefills stay monolithic, but cache-hit TAILS stream
+                # through the chunk program — warm it too
+                outs.append(self._warm_chunk(draft))
+            # COW copy program: a null-page self-copy leaves no state
+            pg = self._put((np.int32(0), np.int32(0)))
+            self._cache = self._copy_fn()(self._cache, *pg)
+            if draft:
+                self._draft_cache = self._draft_copy_fn()(
+                    self._draft_cache, *pg
+                )
         S = cfg.num_slots
         if paged and cfg.spec_k > 0:
             # verify replaces the single-token decode step entirely
@@ -1298,15 +1421,21 @@ class DecodeEngine:
                 required.append(self._draft_decode_fn_)
         else:
             required.append(self._decode_fn)
-        if self.config.prefill_chunk > 0:
+        if self.config.prefill_chunk > 0 or self.config.prefix_cache:
+            # cache-hit tails stream through the chunk program even when
+            # cold prefills are monolithic
             required.append(self._chunk_fn_)
             if self._draft_model is not None:
                 required.append(self._draft_chunk_fn_)
-        else:
+        if self.config.prefill_chunk == 0:
             for bucket in self.config.prompt_buckets:
                 required.append(self._prefill_fns.get(bucket))
                 if self._draft_model is not None:
                     required.append(self._draft_prefill_fns.get(bucket))
+        if self.config.prefix_cache:
+            required.append(self._copy_fn_)
+            if self._draft_model is not None:
+                required.append(self._draft_copy_fn_)
         return all(fn is not None and fn.warm for fn in required)
 
     # ------------------------------------------------------------- hot swap
@@ -1411,6 +1540,18 @@ class DecodeEngine:
         self.weights_step = version
         self._trial = (prev_params, prev_version, ticket)
         self._last_swap_variant = variant
+        if self._prefix is not None:
+            # cached KV is a function of the weights that wrote it — every
+            # entry is now wrong, not just stale. Flushed on APPLY (before
+            # the trial tick, and kept flushed on rollback: conservative,
+            # a rolled-back swap only costs re-prefills). In-flight slots
+            # keep their already-mapped pages — their streams started
+            # under the old weights and finish consistently; the flush
+            # guarantees no POST-swap admission maps a pre-swap page.
+            dropped = self._prefix.invalidate_all()
+            if dropped:
+                self._tick_events.append(f"prefix_invalidate:{dropped}")
+            self._registry.inc("serve/prefix_invalidations")
         # open swap window: closed by commit/rollback; requests whose
         # lifetime intersects it get a swap_overlap span at finish
         self._swap_windows.append({
@@ -1584,9 +1725,13 @@ class DecodeEngine:
                 attrs={"bucket": req.bucket, "chunks": req.chunks},
             )
             if req.reserve_t is not None:
+                attrs = {"pages": self._pages_for(req)
+                         if self._pages is not None else 0}
+                if self._prefix is not None:
+                    attrs["prefix_hit"] = req.prefix_hit
+                    attrs["cached_tokens"] = req.cached_tokens
                 a = tr.begin(trace, "admission", parent=p.span, t0=admit,
-                             attrs={"pages": self._pages_for(req)
-                                    if self._pages is not None else 0})
+                             attrs=attrs)
                 tr.end(a, t1=req.reserve_t)
             tr.end(p, t1=prefill_end)
             if first is not None:
@@ -1673,6 +1818,14 @@ class DecodeEngine:
         total = self._pages.num_pages - 1
         return self._pages.pages_used / total if total > 0 else 0.0
 
+    def page_split(self) -> tuple[int, int]:
+        """(shared, free) page counts for /healthz — how much of the pool
+        is multi-referenced (prefix cache + in-flight sharers) vs
+        immediately allocatable. (0, 0) under the dense layout."""
+        if self._pages is None:
+            return (0, 0)
+        return (self._pages.pages_shared, self._pages.pages_free)
+
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
             if s is None:
@@ -1683,7 +1836,38 @@ class DecodeEngine:
         """Free ``slot`` for reuse; paged layout also returns its pages."""
         self._slots[slot] = None
         if self._pages is not None:
-            self._pages.release(slot)
+            self._release_pages(slot)
+
+    def _release_pages(self, slot: int) -> None:
+        """Drop ``slot``'s page references (shared pages survive in other
+        rows / the prefix cache) and return its quota charge to the
+        tenant. Every release path funnels through here so the per-tenant
+        private-page ledger can never drift from the allocator."""
+        self._pages.release(slot)
+        charge = self._slot_charge.pop(slot, None)
+        if charge is not None:
+            tenant, n = charge
+            left = self._tenant_pages.get(tenant, 0) - n
+            if left > 0:
+                self._tenant_pages[tenant] = left
+            else:
+                self._tenant_pages.pop(tenant, None)
+
+    def _charge_tenant(self, slot: int, tenant: Optional[str],
+                       n: int) -> None:
+        """Ledger ``n`` freshly-allocated (private) pages against
+        ``tenant``'s quota for the lifetime of ``slot``'s reservation.
+        Shared prefix pages are free by design."""
+        if self.config.tenant_page_quota <= 0.0 or tenant is None:
+            return
+        self._tenant_pages[tenant] = self._tenant_pages.get(tenant, 0) + n
+        self._slot_charge[slot] = (tenant, n)
+
+    def _tenant_quota_pages(self) -> int:
+        """Private-page ceiling per tenant (fraction of the usable pool)."""
+        return max(
+            1, int(self.config.tenant_page_quota * (self._pages.num_pages - 1))
+        )
 
     def _pages_for(self, req: GenRequest) -> int:
         """Up-front page reservation for one request: the worst case —
@@ -1701,13 +1885,71 @@ class DecodeEngine:
         """Page-budget admission predicate (``RequestQueue.pop_ready``):
         the whole worst case must be allocatable up front, so an admitted
         request can never starve mid-decode. Dense layout admits on slot
-        availability alone."""
+        availability alone.
+
+        With the prefix cache on, the trie match happens HERE (and is
+        stashed for the admit that immediately follows a True return):
+        only the TAIL pages — reservation minus fully-matched shared pages
+        — must come from the free list, a tenant over its private-page
+        quota is held without counting as page exhaustion, and page
+        pressure first tries LRU-evicting cache-only runs before declaring
+        the head blocked."""
         if self._pages is None:
             return True
-        if self._pages.can_alloc(self._pages_for(req)):
+        need = self._pages_for(req)
+        match = None
+        if self._prefix is not None:
+            # only prompt[:-1] is matchable: the tail prefill must cover at
+            # least the last prompt token (it samples the first output),
+            # which also keeps every later decode/verify write strictly
+            # past the shared full-page region
+            match = self._prefix.match(
+                [int(t) for t in req.prompt_ids[: req.prompt_len - 1]]
+            )
+            self._match_scratch = (req.id, match)
+            # free-list draw: fresh tail pages + the COW private copy
+            # (the partially-matched page itself is mapped, not drawn)
+            need -= len(match.pages)
+        if (
+            self.config.tenant_page_quota > 0.0
+            and req.tenant is not None
+            and self._tenant_pages.get(req.tenant, 0) + need
+            > self._tenant_quota_pages()
+        ):
+            self.tenant_blocked += 1
+            self._registry.inc("serve/tenant_blocked")
+            return False
+        if self._pages.can_alloc(need):
             return True
+        if self._prefix is not None:
+            # page pressure: drop idle cached runs (LRU, refcount-1 only)
+            # before giving up — but never the pages this very match is
+            # about to map
+            protect = set(match.pages)
+            if match.cow_src is not None:
+                protect.add(match.cow_src)
+            if self._prefix.evict_until(
+                need - self._pages.pages_free, protect=protect
+            ) and self._pages.can_alloc(need):
+                return True
         self._page_blocked = True
         return False
+
+    def _take_match(self, req: GenRequest):
+        """Consume the trie match stashed by ``_admission_fits`` for the
+        request that was just popped (None when the cache is off). The
+        accept that returns True is always the LAST one before the pop,
+        so a single scratch slot suffices; the id check is a guard against
+        that invariant ever breaking."""
+        if self._prefix is None:
+            return None
+        stashed, self._match_scratch = self._match_scratch, None
+        if stashed is not None and stashed[0] == req.id:
+            return stashed[1]
+        # accept was skipped or stale (shouldn't happen): re-match
+        return self._prefix.match(
+            [int(t) for t in req.prompt_ids[: req.prompt_len - 1]]
+        )
 
     def _prefill_resident(self) -> int:
         return sum(
@@ -1738,11 +1980,78 @@ class DecodeEngine:
         req.admit_t = time.monotonic()
         self.admitted += 1
         self._registry.inc("serve/admitted")
-        self._pages.admit(slot, self._pages_for(req))
+        n = self._pages_for(req)
+        self._pages.admit(slot, n)
+        self._charge_tenant(slot, req.tenant, n)
         req.reserve_t = time.monotonic()
         self._slots[slot] = _Slot(
             request=req, pending_token=-1, phase="prefill",
             prefill_pos=0, spec=self._slot_spec(req),
+        )
+
+    def _admit_hit(self, req: GenRequest, slot: int, match) -> None:
+        """Prefix-cache-hit admission: map the shared full pages into the
+        slot's block-table row (read-only — refcounts bumped), COW-copy
+        the partially-matched page when the divergence point falls
+        mid-page, and leave the slot in prefill phase at the cached
+        boundary — the tick loop streams only the TAIL through the chunk
+        program. Reservation draws only ``reserved - full`` pages from the
+        free list; the request's worst case is still fully covered, so
+        ``page_exhausted`` can never fire mid-flight."""
+        req.status = "running"
+        req.admit_t = time.monotonic()
+        self.admitted += 1
+        self._registry.inc("serve/admitted")
+        reserved = self._pages_for(req)
+        shared = list(match.pages)
+        cow = match.cow_src is not None
+        if cow:
+            shared.append(match.cow_src)
+        self._pages.admit_shared(slot, shared, reserved - len(shared))
+        self._charge_tenant(slot, req.tenant, reserved - len(match.pages))
+        req.reserve_t = time.monotonic()
+        try:
+            if cow:
+                # private copy BEFORE the tail prefill's first write: the
+                # slot must never write a page with refcount > 1. Stale
+                # lanes past cached_len in the copy are masked by
+                # context_len and overwritten by the tail prefill.
+                old, new = self._pages.cow(slot, len(match.pages))
+                ops = self._put((np.int32(old), np.int32(new)))
+                with watchdog_guard("serve_prefill"):
+                    self._cache = self._copy_fn()(self._cache, *ops)
+                    if self._draft_model is not None:
+                        self._draft_cache = self._draft_copy_fn()(
+                            self._draft_cache, *ops
+                        )
+                self.cow_copies += 1
+                self._registry.inc("serve/cow_copies_total")
+        except BaseException:
+            self._release_pages(slot)
+            raise
+        req.prefix_hit = True
+        req.cached_tokens = match.cached_len
+        self._slots[slot] = _Slot(
+            request=req, pending_token=-1, phase="prefill",
+            prefill_pos=match.cached_len, spec=self._slot_spec(req),
+        )
+
+    def _insert_prefix(self, slot: int, req: GenRequest) -> None:
+        """Index the just-prefilled prompt's FULL pages in the trie (the
+        cache takes its own reference on each newly-indexed page, so they
+        survive the slot's release). Called after the prefill dispatch
+        that wrote the last prompt position — bucket/chunk padding never
+        lands in the first ``prompt_len // page_size`` pages, so every
+        indexed lane holds real K/V."""
+        if self._prefix is None:
+            return
+        ps = self.config.page_size
+        full = req.prompt_len // ps
+        if full <= 0:
+            return
+        self._prefix.insert(
+            [int(t) for t in req.prompt_ids[: full * ps]],
+            self._pages.slot_pages(slot)[:full],
         )
 
     def _admit(self, req: GenRequest, slot: int) -> None:
@@ -1756,7 +2065,9 @@ class DecodeEngine:
         padded[0, : req.prompt_len] = req.prompt_ids
         paged = self._pages is not None
         if paged:
-            self._pages.admit(slot, self._pages_for(req))
+            n = self._pages_for(req)
+            self._pages.admit(slot, n)
+            self._charge_tenant(slot, req.tenant, n)
             req.reserve_t = time.monotonic()
         try:
             # ONE explicit H2D for all host-built operands (np → device);
@@ -1800,8 +2111,13 @@ class DecodeEngine:
         except BaseException:
             # failed admissions must not leak the pages just reserved
             if paged:
-                self._pages.release(slot)
+                self._release_pages(slot)
             raise
+        self.prefill_tokens += req.prompt_len
+        if paged:
+            # index the prompt's full pages BEFORE any release below: the
+            # cache's own reference keeps them alive past the slot
+            self._insert_prefix(slot, req)
         if self.config.sampling == "device":
             token = int(fetched)
         else:
@@ -1809,7 +2125,7 @@ class DecodeEngine:
         self._emit_token(req, token)
         if self._is_terminal(req, token):
             if paged:
-                self._pages.release(slot)
+                self._release_pages(slot)
             return
         self._slots[slot] = _Slot(
             request=req, pending_token=token, spec=self._slot_spec(req)
@@ -1837,7 +2153,7 @@ class DecodeEngine:
         chunk the slot flips to decode phase with its first token emitted;
         decode ticks for OTHER slots keep running between chunks, which is
         the whole point (a long prompt no longer stalls short requests)."""
-        C = self.config.prefill_chunk
+        C = self._chunk_size
         chunks = 0
         for i, s in enumerate(self._slots):
             if s is None or s.phase != "prefill":
@@ -1878,8 +2194,12 @@ class DecodeEngine:
             self.prefill_chunks += 1
             req.chunks += 1
             chunks += 1
+            self.prefill_tokens += end - start
             s.prefill_pos = end
             if is_last:
+                # index the now fully-written prompt pages before any
+                # terminal release (the cache ref keeps them alive)
+                self._insert_prefix(i, req)
                 token = int(fetched)
                 self._emit_token(req, token)
                 if self._is_terminal(req, token):
@@ -2143,11 +2463,20 @@ class DecodeEngine:
         # head blocks the queue — no-bypass backpressure, requests behind
         # it wait for pages to free rather than starving it)
         self._page_blocked = False
+        # "streaming" engines park admitted prompts in prefill phase and
+        # advance them chunk-by-chunk: chunked prefill always, and any
+        # prefix-cache engine (cache-hit tails stream from the cached
+        # boundary even when cold prefills stay monolithic)
         chunked = self._pages is not None and self.config.prefill_chunk > 0
+        streaming = chunked or self._prefix is not None
         while True:
             slot = self._free_slot()
             if slot is None:
                 break
+            # the residency hold only guards CHUNKED engines (long prompts
+            # streaming in over many ticks); a prefix-only engine's hit
+            # tails span at most two chunks, so holding admissions behind
+            # them would just serialize the queue
             req = self._queue.pop_ready(
                 accept=self._admission_fits,
                 defer=self._admission_defer if chunked else None,
@@ -2155,7 +2484,12 @@ class DecodeEngine:
             if req is None:
                 break
             try:
-                if chunked:
+                match = self._take_match(req)
+                if match is not None:
+                    self._prefix.note(match.hit)
+                if match is not None and match.hit:
+                    self._admit_hit(req, slot, match)
+                elif chunked:
                     self._admit_chunked(req, slot)
                 else:
                     self._admit(req, slot)
@@ -2176,7 +2510,7 @@ class DecodeEngine:
         # just-admitted slot gets its first chunk this very tick) and
         # BEFORE decode (its pages must be committed before the verify
         # scatter could reach them)
-        if chunked:
+        if streaming:
             worked = self._advance_prefills() or worked
 
         active = [
@@ -2207,7 +2541,7 @@ class DecodeEngine:
                 top_ks[i] = min(r.top_k, np.iinfo(np.int32).max)
             sample_ops = (seeds, steps, temps, top_ks)
             if self._pages is not None:
-                if chunked:
+                if streaming:
                     # mid-prefill slots hold real pages but are not in
                     # this dispatch — null their rows so the decode
                     # scatter can't stomp a streaming prompt's K/V
@@ -2256,6 +2590,16 @@ class DecodeEngine:
         if self._pages is not None:
             self._registry.gauge("serve/kv_pages_used", self._pages.pages_used)
             self._registry.gauge("serve/kv_pages_free", self._pages.pages_free)
+        if self._prefix is not None:
+            lookups = self._prefix.hits + self._prefix.misses
+            self._registry.gauge(
+                "serve/prefix_hit_rate",
+                self._prefix.hits / lookups if lookups else 0.0,
+            )
+            self._registry.gauge(
+                "serve/pages_shared", self._pages.pages_shared
+            )
+            self._registry.gauge("serve/cow_copies", self.cow_copies)
         if self.brownout is not None:
             level = self.brownout.observe(depth / self._queue.max_depth)
             self._registry.gauge("serve/brownout_level", level)
@@ -2264,6 +2608,13 @@ class DecodeEngine:
                     f"brownout:{self._prev_brownout_level}->{level}"
                 )
                 self._prev_brownout_level = level
+            if level >= 1 and self._prefix is not None:
+                # brownout pressure: idle cached runs are the cheapest
+                # capacity to give back — drop every cache-only page (they
+                # rebuild from traffic once the ladder steps down)
+                dropped = self._prefix.evict_idle()
+                if dropped:
+                    self._tick_events.append(f"prefix_evict_idle:{dropped}")
         now = time.monotonic()
         window = now - self._drain_window_t
         if window >= 1.0:
@@ -2386,8 +2737,20 @@ class DecodeEngine:
             "kv_pages_total": self._pages.num_pages - 1 if paged else None,
             "kv_pages_used": self._pages.pages_used if paged else None,
             "kv_pages_free": self._pages.pages_free if paged else None,
+            "kv_pages_shared": self._pages.pages_shared if paged else None,
             "kv_pages_peak": self._pages.peak_used if paged else None,
             "page_exhausted": self.page_exhausted,
+            "prefill_tokens": self.prefill_tokens,
+            "prefix_cache": (
+                {
+                    **self._prefix.stats(),
+                    "cow_copies": self.cow_copies,
+                    "pages_shared": self._pages.pages_shared,
+                    "tenant_blocked": self.tenant_blocked,
+                    "tenant_page_quota": self.config.tenant_page_quota,
+                }
+                if self._prefix is not None else None
+            ),
             "spec_k": self.config.spec_k,
             "spec_draft": (
                 self.config.spec_draft if self.config.spec_k > 0 else None

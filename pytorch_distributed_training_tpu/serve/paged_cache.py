@@ -7,6 +7,10 @@ this module owns WHICH pages a slot holds. It is deliberately dumb:
 - fixed page size, fixed pool, page ids handed out from a free list;
 - alloc on admit (the whole worst case — prompt + max_new_tokens — up
   front, so a running request can never starve mid-decode), free on evict;
+- refcounted: a page may appear in several block-table rows at once (the
+  prefix cache maps one immutable prompt-prefix run into many slots) and
+  only returns to the free list when its count reaches zero; writers must
+  never touch a page with refcount > 1 — ``cow`` gives them a private copy;
 - defrag-free: pages are interchangeable, so freeing returns ids to the
   free list and there is nothing to compact;
 - page 0 is RESERVED as the null page: never allocated, idle slots park
@@ -59,6 +63,10 @@ class PageAllocator:
         # keeps the working set of hot pages small.
         self._free = list(range(num_pages - 1, 0, -1))
         self._owned: list[list[int]] = [[] for _ in range(num_slots)]
+        # Per-page refcount: 0 = free, 1 = sole owner (a slot OR the prefix
+        # cache), >1 = shared. Page 0 stays permanently at 0 and is never
+        # handed out.
+        self._ref = [0] * num_pages
         self.block_table = np.zeros((num_slots, pages_per_slot), np.int32)
         self.peak_used = 0
 
@@ -70,6 +78,14 @@ class PageAllocator:
     def pages_used(self) -> int:
         # excludes the reserved null page
         return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages referenced by more than one holder (slots + prefix cache)."""
+        return sum(1 for r in self._ref if r > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
 
     def pages_needed(self, total_tokens: int) -> int:
         """Pages covering ``total_tokens`` (prompt + worst-case new)."""
@@ -108,21 +124,111 @@ class PageAllocator:
                 f"page pool exhausted: need {n}, free {len(self._free)} "
                 "(admission must check can_alloc first)"
             )
-        pages = [self._free.pop() for _ in range(n)]
+        pages = [self._pop_free() for _ in range(n)]
         self._owned[slot] = pages
         row = self.block_table[slot]
         row[:] = 0
         row[: len(pages)] = pages
         self.peak_used = max(self.peak_used, self.pages_used)
 
+    def admit_shared(self, slot: int, shared_pages: list[int],
+                     n_private: int) -> None:
+        """Admit ``slot`` with a prefix-cache hit: map ``shared_pages``
+        (already-written pages, refcount bumped — read-only for this slot)
+        followed by ``n_private`` fresh pages for the prompt tail + decode."""
+        if self._owned[slot]:
+            raise RuntimeError(f"slot {slot} already holds pages")
+        n = len(shared_pages) + n_private
+        if n > self.pages_per_slot:
+            raise ValueError(
+                f"request needs {n} pages but block-table rows hold "
+                f"{self.pages_per_slot}"
+            )
+        if n_private > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n_private}, free "
+                f"{len(self._free)} (admission must check can_alloc first)"
+            )
+        for p in shared_pages:
+            self.acquire(p)
+        pages = list(shared_pages)
+        pages.extend(self._pop_free() for _ in range(n_private))
+        self._owned[slot] = pages
+        row = self.block_table[slot]
+        row[:] = 0
+        row[: len(pages)] = pages
+        self.peak_used = max(self.peak_used, self.pages_used)
+
+    def acquire(self, page: int) -> None:
+        """Add a reference to an already-allocated page (sharing it)."""
+        if page <= 0 or page >= self.num_pages:
+            raise ValueError(f"page {page} out of range")
+        if self._ref[page] == 0:
+            raise RuntimeError(
+                f"page {page} is free; acquire only shares live pages"
+            )
+        self._ref[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; the page frees only at refcount 0.
+
+        Returns True when this call actually freed the page. Double release
+        (decref of an already-free page) raises — a freed id may already be
+        in another slot's row, so silently continuing would corrupt it.
+        """
+        if self._ref[page] == 0:
+            raise RuntimeError(f"double release of page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def cow(self, slot: int, index: int) -> tuple[int, int]:
+        """Copy-on-write: repoint ``slot``'s block-table entry ``index`` from
+        its current shared page to a fresh private one.
+
+        Host-side bookkeeping only — the caller must copy the page contents
+        on device (old page id, new page id are returned for that) BEFORE
+        the slot's next write lands. The old page keeps its other holders.
+        """
+        old = self._owned[slot][index]
+        if self._ref[old] <= 1:
+            raise RuntimeError(
+                f"cow on page {old} with refcount {self._ref[old]}; "
+                "exclusively-held pages are written in place"
+            )
+        if not self._free:
+            raise RuntimeError(
+                "page pool exhausted: cow needs 1 free page "
+                "(admission must reserve the private copy up front)"
+            )
+        new = self._pop_free()
+        self._owned[slot][index] = new
+        self.block_table[slot][index] = new
+        self._ref[old] -= 1
+        self.peak_used = max(self.peak_used, self.pages_used)
+        return old, new
+
     def release(self, slot: int) -> None:
-        """Return ``slot``'s pages to the free list (no-op when idle)."""
-        self._free.extend(reversed(self._owned[slot]))
+        """Drop ``slot``'s references; pages free only at refcount 0.
+
+        No-op when idle. Reverse order keeps the LIFO free list handing the
+        most-recently-freed page first, exactly as before refcounts.
+        """
+        for page in reversed(self._owned[slot]):
+            self.decref(page)
         self._owned[slot] = []
         self.block_table[slot][:] = 0
 
     def slot_pages(self, slot: int) -> tuple[int, ...]:
         return tuple(self._owned[slot])
+
+    def _pop_free(self) -> int:
+        page = self._free.pop()
+        assert self._ref[page] == 0, f"free list held live page {page}"
+        self._ref[page] = 1
+        return page
 
 
 def with_tables(pools: Mapping[str, Any], block_table: Any,

@@ -98,6 +98,10 @@ class GenRequest:
     temperature: float = 0.0                # 0 = greedy
     top_k: int = 0
     tier: str = "interactive"               # SLO lane: interactive | batch
+    # Multi-tenant identity: scopes prefix-cache quota accounting and the
+    # queue's per-tenant no-bypass rule. None = single-tenant traffic
+    # (scheduling identical to the pre-tenant queue).
+    tenant: Optional[str] = None
     eot_id: Optional[int] = None
     seed: int = 0                           # per-request sampling stream
     deadline_s: Optional[float] = None      # relative to submit
@@ -131,6 +135,10 @@ class GenRequest:
     # per-request engine accumulators feeding span attributes
     decode_ticks: int = 0
     chunks: int = 0          # chunked-prefill ticks consumed
+    # prefix-cache outcome (engine-owned): whether admission mapped shared
+    # pages, and how many prompt tokens were served from cache
+    prefix_hit: bool = False
+    cached_tokens: int = 0
     drafted: int = 0         # speculative tokens drafted for this request
     accepted: int = 0        # speculative tokens accepted for this request
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
@@ -234,6 +242,13 @@ class RequestQueue:
             raise ValueError(
                 f"tier must be one of {TIERS}, got {request.tier!r}"
             )
+        if request.tenant is not None and (
+            not isinstance(request.tenant, str) or not request.tenant
+        ):
+            raise ValueError(
+                f"tenant must be None or a non-empty string, got "
+                f"{request.tenant!r}"
+            )
         bucket = self.bucket_for(request.prompt_len)
         with self._lock:
             if self._closed:
@@ -289,6 +304,29 @@ class RequestQueue:
                 head = dq
         return head
 
+    def _lane_candidates(self, tier: str) -> list:
+        """Per-tenant admission candidates for one lane, earliest first.
+
+        Each tenant contributes its earliest-submitted queued request (the
+        first of that tenant in each bucket deque, earliest across buckets)
+        — the tenant-scoped version of ``_lane_head``. Single-tenant
+        traffic (every ``tenant`` None) collapses to exactly one candidate,
+        the lane head, so scheduling is unchanged unless tenants are in
+        play. Returns ``[(request, deque), ...]`` sorted by submit time.
+        """
+        best: dict = {}
+        for dq in self._lanes[tier].values():
+            seen = set()
+            for req in dq:
+                t = req.tenant
+                if t in seen:
+                    continue    # FIFO within (bucket, tenant)
+                seen.add(t)
+                cur = best.get(t)
+                if cur is None or req.submit_t < cur[0].submit_t:
+                    best[t] = (req, dq)
+        return sorted(best.values(), key=lambda rd: rd[0].submit_t)
+
     def pop_ready(self, accept=None, defer=None) -> Optional[GenRequest]:
         """Weighted-lane pop: pick a tier lane by weighted round-robin,
         then the earliest-submitted request among that lane's bucket
@@ -310,11 +348,14 @@ class RequestQueue:
 
         ``accept`` (optional) is an admission predicate on the candidate
         head — the engine's page-budget check. Rejection is no-bypass PER
-        LANE: when a lane's head is rejected, no later request of that
-        lane is tried (a big request blocked on pages is never starved by
-        small ones of its own tier slipping past it), but the OTHER lane's
-        head still gets its look — a page-blocked batch giant must not
-        freeze interactive traffic."""
+        (LANE, TENANT): when a tenant's earliest request is rejected, no
+        later request of that tenant-in-lane is tried (a big request
+        blocked on pages is never starved by small ones of its own tenant
+        slipping past it), but every OTHER tenant's head in the lane still
+        gets its look in submit order, and so does the other lane — one
+        quota-exhausted tenant or page-blocked batch giant must not freeze
+        everyone else's traffic. Traffic without tenants is a single
+        candidate per lane, i.e. the historical per-lane no-bypass rule."""
         with self._lock:
             tried: set = set()
             for offset in range(len(self._schedule)):
@@ -324,18 +365,26 @@ class RequestQueue:
                 if tier in tried:
                     continue
                 tried.add(tier)
-                head = self._lane_head(tier)
-                if head is None:
+                candidates = self._lane_candidates(tier)
+                if not candidates:
                     continue
-                if defer is not None and defer(head[0]):
+                if defer is not None and defer(candidates[0][0]):
                     # transient engine-wide hold: nothing pops this tick
                     return None
-                if accept is not None and not accept(head[0]):
-                    continue        # lane head blocked; other lane may go
-                self._cursor = (self._cursor + offset + 1) % len(
-                    self._schedule
-                )
-                return head.popleft()
+                for req, dq in candidates:
+                    if accept is not None and not accept(req):
+                        continue    # that tenant's head blocked; next tenant
+                    self._cursor = (self._cursor + offset + 1) % len(
+                        self._schedule
+                    )
+                    if dq[0] is req:
+                        dq.popleft()
+                    else:
+                        # another tenant ahead of it in the bucket deque is
+                        # blocked; popping mid-deque bypasses tenants, never
+                        # a request of the SAME tenant
+                        dq.remove(req)
+                    return req
             return None
 
     def wait_for_work(self, timeout: float) -> bool:

@@ -210,6 +210,7 @@ class InferenceServer:
         seed: int = 0,
         deadline_s: Optional[float] = None,
         tier: str = "interactive",
+        tenant: Optional[str] = None,
         stream=None,
         on_finish=None,
         request_id: Optional[str] = None,
@@ -230,6 +231,7 @@ class InferenceServer:
             temperature=temperature,
             top_k=top_k,
             tier=tier,
+            tenant=tenant,
             eot_id=eot_id,
             seed=seed,
             deadline_s=deadline_s,
@@ -302,6 +304,10 @@ class InferenceServer:
             # autoscaler pressure signals: KV page-pool occupancy and the
             # current brownout rung (0 when no controller is attached)
             "page_occupancy": self.engine.page_occupancy(),
+            # shared/free page split (prefix cache): how much of the pool
+            # is multi-referenced vs immediately allocatable
+            "kv_pages_shared": self.engine.page_split()[0],
+            "kv_pages_free": self.engine.page_split()[1],
             "brownout_level": (
                 self.engine.brownout.level
                 if self.engine.brownout is not None else 0
@@ -558,6 +564,16 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     "id": rid,
                 }, headers={"X-Request-Id": rid})
                 return
+            tenant = msg.get("tenant")
+            if tenant is not None and (
+                not isinstance(tenant, str) or not tenant
+            ):
+                self._json(400, {
+                    "error": f"tenant must be a non-empty string, got "
+                             f"{tenant!r}",
+                    "id": rid,
+                }, headers={"X-Request-Id": rid})
+                return
             brownout = server.engine.brownout
             if brownout is not None and brownout.sheds(tier):
                 # the degradation ladder's explicit rejection: batch sheds
@@ -641,6 +657,7 @@ def make_http_server(server: InferenceServer, tokenizer, host="127.0.0.1",
                     seed=int(msg.get("seed", 0)),
                     deadline_s=msg.get("deadline_s"),
                     tier=tier,
+                    tenant=tenant,
                     stream=on_token,
                     on_finish=on_finish,
                     request_id=rid,

@@ -67,6 +67,14 @@ class TraceConfig:
     output_tokens_max: int = 64
     interactive_deadline_s: float = 30.0
     batch_deadline_s: float = 120.0
+    #: multi-tenant shared-system-prompt mix (bench.py --prefix): 0 keeps
+    #: the legacy single-tenant trace BIT-IDENTICAL (no extra rng draws).
+    #: With N tenants, each event is assigned a tenant uniformly and its
+    #: prompt becomes [tenant's shared prefix of ``shared_prefix_len``
+    #: tokens] + [log-normal private tail] — the workload where serving
+    #: the prefix once is the dominant win.
+    tenants: int = 0
+    shared_prefix_len: int = 0
 
     def __post_init__(self):
         if self.duration_s <= 0:
@@ -84,6 +92,18 @@ class TraceConfig:
                     f"burst episodes need start >= 0 and duration > 0, "
                     f"got ({start}, {dur})"
                 )
+        if self.tenants < 0:
+            raise ValueError(f"tenants must be >= 0, got {self.tenants}")
+        if self.shared_prefix_len < 0:
+            raise ValueError(
+                f"shared_prefix_len must be >= 0, got "
+                f"{self.shared_prefix_len}"
+            )
+        if self.tenants > 0 and self.shared_prefix_len == 0:
+            raise ValueError(
+                "tenants > 0 needs shared_prefix_len > 0 (a tenant mix "
+                "without shared prefixes is just the plain trace)"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +120,11 @@ class TraceEvent:
     #: True when the arrival fell inside a burst episode (labels the storm
     #: window in telemetry without re-deriving it from timestamps)
     burst: bool
+    #: multi-tenant mix (cfg.tenants > 0): which tenant sent this request,
+    #: and how many leading prompt tokens are that tenant's SHARED system
+    #: prefix (prompt_len includes them). None/0 on single-tenant traces.
+    tenant: Optional[str] = None
+    prefix_len: int = 0
 
 
 def _in_burst(cfg: TraceConfig, t: float) -> bool:
@@ -138,25 +163,45 @@ def generate_trace(cfg: TraceConfig) -> list:
             if rng.random() < cfg.interactive_fraction
             else "batch"
         )
+        prompt_len = _clamped_lognormal(
+            rng, cfg.prompt_len_median, cfg.prompt_len_sigma,
+            cfg.prompt_len_min, cfg.prompt_len_max,
+        )
+        max_new_tokens = _clamped_lognormal(
+            rng, cfg.output_tokens_median, cfg.output_tokens_sigma,
+            cfg.output_tokens_min, cfg.output_tokens_max,
+        )
+        seed = rng.randrange(2**31)
+        # tenant draws come AFTER every legacy draw and only when the mix
+        # is on: a tenants=0 trace consumes the identical rng stream as
+        # before this field existed (determinism pin extended, not moved)
+        tenant = None
+        prefix_len = 0
+        if cfg.tenants > 0:
+            tenant = f"tenant{rng.randrange(cfg.tenants)}"
+            prefix_len = cfg.shared_prefix_len
+            # the log-normal draw becomes the PRIVATE tail; the shared
+            # system prefix rides in front (total still bounded, with at
+            # least one private token so streams can diverge)
+            prompt_len = min(
+                prefix_len + prompt_len,
+                max(cfg.prompt_len_max, prefix_len + 1),
+            )
         events.append(TraceEvent(
             index=index,
             t_s=t,
             tier=tier,
-            prompt_len=_clamped_lognormal(
-                rng, cfg.prompt_len_median, cfg.prompt_len_sigma,
-                cfg.prompt_len_min, cfg.prompt_len_max,
-            ),
-            max_new_tokens=_clamped_lognormal(
-                rng, cfg.output_tokens_median, cfg.output_tokens_sigma,
-                cfg.output_tokens_min, cfg.output_tokens_max,
-            ),
+            prompt_len=prompt_len,
+            max_new_tokens=max_new_tokens,
             deadline_s=(
                 cfg.interactive_deadline_s
                 if tier == "interactive"
                 else cfg.batch_deadline_s
             ),
-            seed=rng.randrange(2**31),
+            seed=seed,
             burst=_in_burst(cfg, t),
+            tenant=tenant,
+            prefix_len=prefix_len,
         ))
         index += 1
     return events
@@ -167,6 +212,10 @@ def trace_stats(events: list) -> dict:
     by_tier = {tier: 0 for tier in TIERS}
     for ev in events:
         by_tier[ev.tier] += 1
+    by_tenant: dict = {}
+    for ev in events:
+        if ev.tenant is not None:
+            by_tenant[ev.tenant] = by_tenant.get(ev.tenant, 0) + 1
     return {
         "events": len(events),
         "by_tier": by_tier,
@@ -176,6 +225,7 @@ def trace_stats(events: list) -> dict:
         "output_tokens_max": max(
             (ev.max_new_tokens for ev in events), default=0
         ),
+        "by_tenant": by_tenant,
     }
 
 

@@ -475,6 +475,35 @@ def summarize_precision(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_prefix(records: list[dict]) -> dict | None:
+    """Fold the engine's prefix-cache counters (the nested
+    ``prefix_cache`` dict in the final ``serve_summary``) into the
+    shared-KV view: lookup/hit traffic, trie churn (inserts, LRU
+    evictions, swap invalidations), pages currently indexed and shared,
+    COW copies, and tenant-quota admission holds. None when the stream
+    predates the prefix cache or the engine ran with it off."""
+    summaries = [r for r in records if r.get("record") == "serve_summary"]
+    if not summaries:
+        return None
+    prefix = summaries[-1].get("prefix_cache")
+    if not prefix:
+        return None
+    return {
+        "lookups": prefix.get("prefix_lookups"),
+        "hits": prefix.get("prefix_hits"),
+        "hit_rate": prefix.get("prefix_hit_rate"),
+        "inserts": prefix.get("prefix_inserts"),
+        "evictions": prefix.get("prefix_evictions"),
+        "invalidations": prefix.get("prefix_invalidations"),
+        "cached_pages": prefix.get("prefix_cached_pages"),
+        "pages_shared": prefix.get("pages_shared"),
+        "cow_copies": prefix.get("cow_copies"),
+        "tenant_blocked": prefix.get("tenant_blocked"),
+        "tenant_page_quota": prefix.get("tenant_page_quota"),
+        "prefill_tokens": summaries[-1].get("prefill_tokens"),
+    }
+
+
 def summarize_serve(records: list[dict]) -> dict | None:
     """Fold ``serve_request`` records into per-bucket latency percentiles
     plus aggregate serving stats; None when the stream holds none."""
@@ -519,6 +548,7 @@ def summarize_serve(records: list[dict]) -> dict | None:
         "paged": summarize_paged(records),
         "spec": summarize_spec(records),
         "precision": summarize_precision(records),
+        "prefix": summarize_prefix(records),
     }
 
 
@@ -801,6 +831,23 @@ def render_serve_table(serve: dict) -> str:
             lines.append(
                 f"kv-cache: dense  sampling={paged.get('sampling')}"
             )
+    prefix = serve.get("prefix")
+    if prefix:
+        line = (
+            f"prefix-cache: hit-rate={_fmt(prefix.get('hit_rate'), '.3f')} "
+            f"({_fmt(prefix.get('hits'))}/{_fmt(prefix.get('lookups'))}) "
+            f"cached-pages={_fmt(prefix.get('cached_pages'))} "
+            f"shared={_fmt(prefix.get('pages_shared'))} "
+            f"cow={_fmt(prefix.get('cow_copies'))} "
+            f"evictions={_fmt(prefix.get('evictions'))} "
+            f"invalidations={_fmt(prefix.get('invalidations'))}"
+        )
+        if prefix.get("tenant_page_quota"):
+            line += (
+                f" tenant-quota={_fmt(prefix['tenant_page_quota'], '.2f')}"
+                f" tenant-blocked={_fmt(prefix.get('tenant_blocked'))}"
+            )
+        lines.append(line)
     spec = serve.get("spec")
     if spec:
         line = (
